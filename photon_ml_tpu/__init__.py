@@ -1,0 +1,19 @@
+"""photon_ml_tpu — TPU-native GLM + GAME (mixed-effect) training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of LinkedIn Photon ML
+(reference: /root/reference, Scala/Spark): generalized linear models
+(logistic/linear/Poisson regression, smoothed-hinge linear SVM) with
+L1/L2/elastic-net regularization, box constraints, feature normalization,
+offsets, and the GAME coordinate-descent loop over fixed-effect and
+per-entity random-effect coordinates — redesigned for TPU:
+
+  - loss/gradient/Hessian kernels are fused XLA reductions over [n, d]
+    batches (ops/), not per-datum streaming aggregators;
+  - optimizers (LBFGS/OWLQN/TRON) are jittable lax.while_loop programs that
+    also run vmapped, so millions of per-entity random-effect solves become
+    one batched kernel (optim/);
+  - distribution is jax.sharding over a device Mesh with ICI collectives
+    (parallel/), not Spark shuffles/broadcasts.
+"""
+
+__version__ = "0.1.0"

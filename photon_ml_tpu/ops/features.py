@@ -1,0 +1,60 @@
+"""Feature-matrix abstraction: dense jnp arrays or sparse BCOO.
+
+The reference streams Breeze sparse/dense vectors per datum (reference:
+photon-lib/.../data/DataPoint.scala, util/VectorUtils.scala).  On TPU the unit
+of work is the whole batch: a feature matrix X of shape [n, d], either dense
+(the common case after densification — e.g. a1a is d=123, the Yahoo! Music
+fixture d=14,983) or `jax.experimental.sparse.BCOO` when d is large and rows
+are sparse.  Every kernel in ops/aggregators.py only touches X through the
+three products below, so both representations (and future pallas kernels)
+plug in transparently.  Both are pytrees, so they flow through
+jit/vmap/shard_map unchanged.
+"""
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+FeatureMatrix = Union[jax.Array, jsparse.BCOO]
+
+
+def is_sparse(x: FeatureMatrix) -> bool:
+    return isinstance(x, jsparse.BCOO)
+
+
+def num_features(x: FeatureMatrix) -> int:
+    return x.shape[-1]
+
+
+def num_rows(x: FeatureMatrix) -> int:
+    return x.shape[0]
+
+
+def matvec(x: FeatureMatrix, v: jax.Array) -> jax.Array:
+    """X @ v -> [n].  The margin kernel."""
+    return x @ v
+
+
+def rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
+    """X^T @ u -> [d].  The gradient-assembly kernel."""
+    if is_sparse(x):
+        # BCOO transpose-matvec: (u @ X) contracts over rows.
+        return u @ x
+    return x.T @ u
+
+
+def sq_rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
+    """(X*X)^T @ u -> [d].  Used by the Hessian-diagonal aggregator
+    (reference: photon-lib/.../function/glm/HessianDiagonalAggregator.scala:33)."""
+    if is_sparse(x):
+        x2 = jsparse.BCOO((x.data * x.data, x.indices), shape=x.shape,
+                          indices_sorted=x.indices_sorted, unique_indices=x.unique_indices)
+        return u @ x2
+    return (x * x).T @ u
+
+
+def densify(x: FeatureMatrix) -> jax.Array:
+    return x.todense() if is_sparse(x) else x

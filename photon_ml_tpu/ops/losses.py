@@ -1,0 +1,151 @@
+"""Pointwise GLM loss kernels.
+
+The entire per-model math of the framework is, as in the reference, two scalar
+functions of the margin z = w.x + offset:
+
+    loss_and_dz(z, y) -> (l, dl/dz)
+    d2z(z, y)         -> d2l/dz2
+
+(reference: photon-lib/.../function/glm/PointwiseLossFunction.scala:36-54).
+
+Each loss also carries its inverse-link `mean(z)` (used by the model classes
+for prediction, reference: photon-api/.../supervised/model/GeneralizedLinearModel.scala
+computeMean) and a task-type tag.
+
+Losses are frozen singletons of pure jnp functions: they are static from JAX's
+point of view, so they can be closed over by jit/vmap/shard_map'd functions
+without becoming tracers.  Labels for classification tasks are {0, 1} at the
+API surface and remapped to {-1, +1} internally, matching the reference
+(LogisticLossFunction.scala:45-90, SmoothedHingeLossFunction.scala:41).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.utils.math import log1p_exp
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss l(z, y) with first/second derivatives in z.
+
+    reference: photon-lib/.../function/glm/PointwiseLossFunction.scala:36-54.
+    `twice_differentiable` gates TRON eligibility (the smoothed hinge is
+    once-differentiable and restricted to LBFGS/OWLQN in the reference:
+    SmoothedHingeLossFunction.scala docstring).
+    """
+
+    name: str
+    loss: Callable[[jax.Array, jax.Array], jax.Array]
+    dz: Callable[[jax.Array, jax.Array], jax.Array]
+    d2z: Callable[[jax.Array, jax.Array], jax.Array]
+    mean: Callable[[jax.Array], jax.Array]
+    twice_differentiable: bool = True
+
+    def loss_and_dz(self, z: jax.Array, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        return self.loss(z, y), self.dz(z, y)
+
+    def __hash__(self):  # stable identity for jit static args
+        return hash(self.name)
+
+    def __eq__(self, other):
+        return isinstance(other, PointwiseLoss) and other.name == self.name
+
+
+def _pm1(y: jax.Array) -> jax.Array:
+    """{0,1} (or already ±1) labels -> ±1, as the reference remaps."""
+    return jnp.where(y > 0.5, 1.0, -1.0).astype(y.dtype)
+
+
+# --- logistic: l = log1pExp(-yy*z), yy = ±1 ---------------------------------
+# reference: photon-api/.../function/glm/LogisticLossFunction.scala:45-90
+def _logistic_loss(z, y):
+    return log1p_exp(-_pm1(y) * z)
+
+
+def _logistic_dz(z, y):
+    yy = _pm1(y)
+    return -yy * jax.nn.sigmoid(-yy * z)
+
+
+def _logistic_d2z(z, y):
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+LOGISTIC = PointwiseLoss(
+    name="logistic",
+    loss=_logistic_loss,
+    dz=_logistic_dz,
+    d2z=_logistic_d2z,
+    mean=jax.nn.sigmoid,
+)
+
+
+# --- squared: l = 0.5 (z - y)^2 ---------------------------------------------
+# reference: photon-api/.../function/glm/SquaredLossFunction.scala:32-55
+SQUARED = PointwiseLoss(
+    name="squared",
+    loss=lambda z, y: 0.5 * (z - y) ** 2,
+    dz=lambda z, y: z - y,
+    d2z=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+
+# --- poisson: l = exp(z) - y z ----------------------------------------------
+# reference: photon-api/.../function/glm/PoissonLossFunction.scala:31-53
+POISSON = PointwiseLoss(
+    name="poisson",
+    loss=lambda z, y: jnp.exp(z) - y * z,
+    dz=lambda z, y: jnp.exp(z) - y,
+    d2z=lambda z, y: jnp.exp(z),
+    mean=jnp.exp,
+)
+
+
+# --- smoothed hinge (Rennie): piecewise in t = yy*z -------------------------
+# reference: photon-api/.../function/svm/SmoothedHingeLossFunction.scala:30-85
+def _shinge_loss(z, y):
+    t = _pm1(y) * z
+    return jnp.where(t < 0.0, 0.5 - t, jnp.where(t < 1.0, 0.5 * (1.0 - t) ** 2, 0.0))
+
+
+def _shinge_dz(z, y):
+    yy = _pm1(y)
+    t = yy * z
+    dldt = jnp.where(t < 0.0, -1.0, jnp.where(t < 1.0, t - 1.0, 0.0))
+    return yy * dldt
+
+
+def _shinge_d2z(z, y):
+    t = _pm1(y) * z
+    return jnp.where((t >= 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+SMOOTHED_HINGE = PointwiseLoss(
+    name="smoothed_hinge",
+    loss=_shinge_loss,
+    dz=_shinge_dz,
+    d2z=_shinge_d2z,
+    mean=lambda z: z,  # raw-margin classifier, reference SmoothedHingeLossLinearSVMModel
+    twice_differentiable=False,
+)
+
+
+BY_NAME = {
+    l.name: l for l in (LOGISTIC, SQUARED, POISSON, SMOOTHED_HINGE)
+}
+
+# TaskType -> loss, mirroring the reference's TaskType enum wiring
+# (reference: photon-api/.../TaskType usage in ModelTraining.scala:127-148)
+TASK_LOSSES = {
+    "logistic_regression": LOGISTIC,
+    "linear_regression": SQUARED,
+    "poisson_regression": POISSON,
+    "smoothed_hinge_loss_linear_svm": SMOOTHED_HINGE,
+}

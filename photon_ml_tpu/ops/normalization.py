@@ -1,0 +1,148 @@
+"""Feature normalization as margin-invariant algebra.
+
+reference: photon-lib/.../normalization/NormalizationContext.scala:38-165 and
+NormalizationType.java:20-45.
+
+The central trick, kept from the reference: normalized features
+x' = (x - shift) * factor are NEVER materialized.  Instead every kernel works
+on raw X with an *effective coefficient* e = c * factor and a scalar margin
+shift -e.shift, so that  x'.c == x.e - e.shift  exactly
+(reference: ValueAndGradientAggregator.scala:35-79).  This keeps sparse inputs
+sparse and saves an [n, d] materialization on HBM.
+
+A context is a pytree (factors/shifts are arrays or None), so it can be closed
+over or passed through jit boundaries freely.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class NormalizationType(str, enum.Enum):
+    """reference: photon-lib/.../normalization/NormalizationType.java:20-45."""
+
+    NONE = "none"
+    SCALE_WITH_STANDARD_DEVIATION = "scale_with_standard_deviation"
+    SCALE_WITH_MAX_MAGNITUDE = "scale_with_max_magnitude"
+    STANDARDIZATION = "standardization"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class NormalizationContext:
+    """factors/shifts with the intercept pinned to (factor=1, shift=0).
+
+    reference: NormalizationContext.scala:38-62.  `factors is None` means no
+    scaling, `shifts is None` means no translation; NoNormalization is
+    NormalizationContext(None, None, ...).
+    """
+
+    factors: Optional[jax.Array]
+    shifts: Optional[jax.Array]
+    intercept_index: Optional[int] = None
+
+    # -- pytree plumbing (intercept_index is static) --
+    def tree_flatten(self):
+        return (self.factors, self.shifts), self.intercept_index
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def effective_coefficients(self, coefficients: jax.Array) -> jax.Array:
+        """e = c * factor (reference: ValueAndGradientAggregator.scala:35-48)."""
+        if self.factors is None:
+            return coefficients
+        return coefficients * self.factors
+
+    def margin_shift(self, effective_coefficients: jax.Array) -> jax.Array:
+        """-e.shift, the scalar added to every margin."""
+        if self.shifts is None:
+            return jnp.zeros((), dtype=effective_coefficients.dtype)
+        return -jnp.dot(effective_coefficients, self.shifts)
+
+    def model_to_original_space(self, coefficients: jax.Array) -> jax.Array:
+        """Map coefficients trained in normalized space back to raw-feature
+        space, preserving margins (reference: NormalizationContext.scala:64-95).
+        """
+        c = self.effective_coefficients(coefficients)
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("shift normalization requires an intercept")
+            c = c.at[self.intercept_index].add(-jnp.dot(c, self.shifts))
+        return c
+
+    def model_to_transformed_space(self, coefficients: jax.Array) -> jax.Array:
+        """Inverse of model_to_original_space (reference:
+        NormalizationContext.scala:97-113).  Used for warm starts: a model in
+        original space is mapped into normalized space before optimization."""
+        c = coefficients
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("shift normalization requires an intercept")
+            c = c.at[self.intercept_index].add(jnp.dot(c, self.shifts))
+        if self.factors is not None:
+            c = c / self.factors
+        return c
+
+
+def no_normalization() -> NormalizationContext:
+    return NormalizationContext(None, None, None)
+
+
+def build_normalization_context(
+    norm_type: NormalizationType | str,
+    *,
+    mean: Optional[jax.Array] = None,
+    variance: Optional[jax.Array] = None,
+    max_magnitude: Optional[jax.Array] = None,
+    intercept_index: Optional[int] = None,
+) -> NormalizationContext:
+    """Factory from feature summary statistics.
+
+    reference: NormalizationContext.scala:114-160 (apply(normalizationType,
+    summary, interceptId)).  Zero-variance / zero-magnitude features get
+    factor 1 so constant columns survive.  The intercept column is pinned to
+    factor=1, shift=0.
+    """
+    norm_type = NormalizationType(norm_type)
+    if norm_type == NormalizationType.NONE:
+        return no_normalization()
+
+    def _pin_intercept(arr: jax.Array, value: float) -> jax.Array:
+        if intercept_index is None:
+            return arr
+        return arr.at[intercept_index].set(value)
+
+    if norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        if max_magnitude is None:
+            raise ValueError("max_magnitude summary required")
+        safe = jnp.where(max_magnitude > 0, max_magnitude, 1.0)
+        return NormalizationContext(_pin_intercept(1.0 / safe, 1.0), None, intercept_index)
+
+    if variance is None:
+        raise ValueError("variance summary required")
+    std = jnp.sqrt(variance)
+    factors = _pin_intercept(jnp.where(std > 0, 1.0 / jnp.where(std > 0, std, 1.0), 1.0), 1.0)
+
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        return NormalizationContext(factors, None, intercept_index)
+
+    # STANDARDIZATION: scale by 1/std and shift by the mean
+    if mean is None:
+        raise ValueError("mean summary required")
+    if intercept_index is None:
+        raise ValueError(
+            "STANDARDIZATION requires an intercept term to absorb the shift "
+            "(reference: NormalizationContext.scala factory requirement)")
+    shifts = _pin_intercept(mean, 0.0)
+    return NormalizationContext(factors, shifts, intercept_index)

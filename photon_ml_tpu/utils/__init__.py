@@ -1,0 +1,1 @@
+from photon_ml_tpu.utils.math import EPSILON, is_almost_zero, log1p_exp, safe_div  # noqa: F401

@@ -1,0 +1,28 @@
+"""Test fixture: CPU backend with 8 virtual devices + float64.
+
+This is the TPU-rebuild equivalent of the reference's `sparkTest` local-mode
+fixture (reference: photon-test-utils/.../test/SparkTestUtils.scala:31-77):
+all distributed code paths run on an 8-device virtual CPU mesh, and parity
+math runs in float64 to match the all-double JVM reference.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+# Persistent compilation cache: repeated test runs skip recompilation.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)

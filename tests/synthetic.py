@@ -1,0 +1,45 @@
+"""Seeded synthetic data generators for tests.
+
+Role of the reference's SparkTestUtils generators (reference:
+photon-test-utils/.../test/SparkTestUtils.scala:85+, e.g.
+drawBalancedSampleFromNumericallyBenignDenseFeaturesForBinaryClassifierLocal)
+and GameTestUtils (photon-api/.../util/GameTestUtils.scala:61-296).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_glm_data(rng, n=256, d=10, task="logistic", noise=0.1, weight_range=None):
+    """Well-conditioned GLM data with known true coefficients."""
+    x = rng.normal(size=(n, d))
+    x[:, -1] = 1.0  # intercept column
+    w_true = rng.normal(size=d)
+    z = x @ w_true
+    if task == "logistic":
+        p = 1.0 / (1.0 + np.exp(-z))
+        y = (rng.uniform(size=n) < p).astype(float)
+    elif task == "linear":
+        y = z + noise * rng.normal(size=n)
+    elif task == "poisson":
+        z = 0.3 * z  # keep rates sane
+        w_true = 0.3 * w_true
+        y = rng.poisson(np.exp(z)).astype(float)
+    elif task == "hinge":
+        y = (z > 0).astype(float)
+    else:
+        raise ValueError(task)
+    weights = None
+    if weight_range is not None:
+        weights = rng.uniform(*weight_range, size=n)
+    return x, y, weights, w_true
+
+
+def make_entity_data(rng, num_entities=16, samples_per_entity=(5, 40), d=4, task="logistic"):
+    """Ragged per-entity datasets for random-effect tests."""
+    out = []
+    for _ in range(num_entities):
+        n = int(rng.integers(*samples_per_entity))
+        x, y, _, w = make_glm_data(rng, n=n, d=d, task=task)
+        out.append((x, y, w))
+    return out
