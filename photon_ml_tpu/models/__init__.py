@@ -1,0 +1,7 @@
+from photon_ml_tpu.models.coefficients import Coefficients  # noqa: F401
+from photon_ml_tpu.models.glm import (  # noqa: F401
+    TASK_MODELS, GeneralizedLinearModel, LinearRegressionModel,
+    LogisticRegressionModel, PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel, model_for_task,
+)
+from photon_ml_tpu.models.training import TrainedModel, best_model_by_validation, train_glm  # noqa: F401

@@ -1,0 +1,48 @@
+"""Model coefficients (means + optional variances).
+
+reference: photon-lib/.../model/Coefficients.scala:31-168.
+A pytree so models flow through jit/vmap; `variances` comes from the
+Hessian-diagonal estimate (reference: DistributedOptimizationProblem
+.computeVariances:80-95).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.utils.math import EPSILON
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Coefficients:
+    means: jax.Array
+    variances: Optional[jax.Array] = None
+
+    def tree_flatten(self):
+        return (self.means, self.variances), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, x) -> jax.Array:
+        """x may be [d] or a feature matrix [n, d] (dense or BCOO).
+        reference: Coefficients.computeScore (Coefficients.scala:53)."""
+        return x @ self.means
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(jnp.zeros((dim,), dtype))
+
+    @staticmethod
+    def from_hessian_diagonal(means: jax.Array, hess_diag: jax.Array) -> "Coefficients":
+        """var_j ~= 1 / (H_jj + eps) (reference: GLMLossFunction variance path)."""
+        return Coefficients(means, 1.0 / (hess_diag + EPSILON))

@@ -1,0 +1,110 @@
+"""Generalized linear model classes.
+
+reference:
+  - GeneralizedLinearModel (photon-api/.../supervised/model/GeneralizedLinearModel.scala:34)
+  - LogisticRegressionModel (.../supervised/classification/LogisticRegressionModel.scala:35)
+  - SmoothedHingeLossLinearSVMModel (.../classification/SmoothedHingeLossLinearSVMModel.scala)
+  - LinearRegressionModel / PoissonRegressionModel (.../supervised/regression/*.scala)
+
+Each model pairs Coefficients with its PointwiseLoss; scoring is a batched
+margin (one MXU matvec per shard) and `predict` applies the inverse link
+(`loss.mean`).  Classification models expose the BinaryClassifier threshold
+API of the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.ops import losses as L
+from photon_ml_tpu.ops.features import FeatureMatrix
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class GeneralizedLinearModel:
+    """Base GLM: margin scoring + mean prediction."""
+
+    coefficients: Coefficients
+
+    loss: ClassVar[L.PointwiseLoss] = L.SQUARED
+    task_type: ClassVar[str] = "none"
+
+    def tree_flatten(self):
+        return (self.coefficients,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+    def compute_score(self, x: FeatureMatrix, offsets: Optional[jax.Array] = None) -> jax.Array:
+        """Margin z = x.w (+ offset) — reference computeScore."""
+        z = self.coefficients.compute_score(x)
+        return z if offsets is None else z + offsets
+
+    def predict(self, x: FeatureMatrix, offsets: Optional[jax.Array] = None) -> jax.Array:
+        """Mean response — reference computeMean (GeneralizedLinearModel.scala)."""
+        return type(self).loss.mean(self.compute_score(x, offsets))
+
+    def validate_coefficients(self) -> bool:
+        """reference: GeneralizedLinearModel.validateCoefficients (all finite)."""
+        return bool(jnp.all(jnp.isfinite(self.coefficients.means)))
+
+    def with_coefficients(self, coefficients: Coefficients):
+        return dataclasses.replace(self, coefficients=coefficients)
+
+    def __len__(self):
+        return self.coefficients.dim
+
+
+class _BinaryClassifier(GeneralizedLinearModel):
+    """Threshold API of the reference's BinaryClassifier trait."""
+
+    def predict_class(self, x: FeatureMatrix, offsets: Optional[jax.Array] = None,
+                      threshold: float = 0.5) -> jax.Array:
+        return (self.predict(x, offsets) >= threshold).astype(jnp.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+class LogisticRegressionModel(_BinaryClassifier):
+    loss: ClassVar[L.PointwiseLoss] = L.LOGISTIC
+    task_type: ClassVar[str] = "logistic_regression"
+
+
+@jax.tree_util.register_pytree_node_class
+class SmoothedHingeLossLinearSVMModel(_BinaryClassifier):
+    loss: ClassVar[L.PointwiseLoss] = L.SMOOTHED_HINGE
+    task_type: ClassVar[str] = "smoothed_hinge_loss_linear_svm"
+
+    def predict_class(self, x, offsets=None, threshold: float = 0.0) -> jax.Array:
+        # raw-margin classifier: threshold on the margin itself
+        return (self.compute_score(x, offsets) >= threshold).astype(jnp.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+class LinearRegressionModel(GeneralizedLinearModel):
+    loss: ClassVar[L.PointwiseLoss] = L.SQUARED
+    task_type: ClassVar[str] = "linear_regression"
+
+
+@jax.tree_util.register_pytree_node_class
+class PoissonRegressionModel(GeneralizedLinearModel):
+    loss: ClassVar[L.PointwiseLoss] = L.POISSON
+    task_type: ClassVar[str] = "poisson_regression"
+
+
+TASK_MODELS = {
+    cls.task_type: cls
+    for cls in (LogisticRegressionModel, LinearRegressionModel,
+                PoissonRegressionModel, SmoothedHingeLossLinearSVMModel)
+}
+
+
+def model_for_task(task_type: str, coefficients: Coefficients) -> GeneralizedLinearModel:
+    """Factory, reference: the glmConstructor passed into optimization
+    problems (GeneralizedLinearOptimizationProblem.scala:39)."""
+    return TASK_MODELS[task_type](coefficients)

@@ -82,8 +82,10 @@ def value_and_gradient(
     wdl = _apply_weights(dl, weights)
     wl = _apply_weights(l, weights)
     if mask is not None:
-        wdl = wdl * mask
-        wl = wl * mask
+        # where() not multiply: a non-finite loss on a padded row must not
+        # poison the aggregate (inf * 0 == nan)
+        wdl = jnp.where(mask != 0, wdl, 0.0)
+        wl = jnp.where(mask != 0, wl, 0.0)
     value = jnp.sum(wl)
     grad = fops.rmatvec(x, wdl)
     if norm is not None and not norm.is_identity:
@@ -109,7 +111,7 @@ def value_only(
     z = compute_margins(x, coefficients, offsets, norm)
     wl = _apply_weights(loss.loss(z, labels), weights)
     if mask is not None:
-        wl = wl * mask
+        wl = jnp.where(mask != 0, wl, 0.0)
     return jnp.sum(wl)
 
 
@@ -139,7 +141,7 @@ def hessian_vector(
         dz = fops.matvec(x, vector)
     wd2dz = _apply_weights(d2 * dz, weights)
     if mask is not None:
-        wd2dz = wd2dz * mask
+        wd2dz = jnp.where(mask != 0, wd2dz, 0.0)
     hv = fops.rmatvec(x, wd2dz)
     if norm is not None and not norm.is_identity:
         if norm.shifts is not None:
@@ -168,5 +170,5 @@ def hessian_diagonal(
     z = compute_margins(x, coefficients, offsets, None)
     wd2 = _apply_weights(loss.d2z(z, labels), weights)
     if mask is not None:
-        wd2 = wd2 * mask
+        wd2 = jnp.where(mask != 0, wd2, 0.0)
     return fops.sq_rmatvec(x, wd2)

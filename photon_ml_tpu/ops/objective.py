@@ -91,6 +91,13 @@ class GLMObjective:
         return hv + self.l2_weight * v
 
     def hessian_diagonal(self, c: jax.Array) -> jax.Array:
+        """Original-space only: the aggregator has no normalization support
+        (reference: HessianDiagonalAggregator.scala), so calling this on a
+        normalized objective would silently mix spaces."""
+        if self.norm is not None and not self.norm.is_identity:
+            raise ValueError(
+                "hessian_diagonal is original-space only; use "
+                "objective.replace(norm=None) with original-space coefficients")
         hd = agg.hessian_diagonal(self.loss, self.x, self.labels, c,
                                   weights=self.weights, offsets=self.offsets,
                                   mask=self.mask)

@@ -1,0 +1,287 @@
+"""LBFGS and OWLQN as pure jittable/vmappable lax.while_loop programs.
+
+Role of the reference's LBFGS/OWLQN adaptors over breeze.optimize
+(photon-lib/.../optimization/LBFGS.scala:39-156, OWLQN.scala:40-86).  Unlike
+the reference — which hands the loop to a JVM library and streams RDD
+aggregates per iteration — the whole optimization is one XLA program:
+
+  * runs on-device with zero host round-trips per iteration;
+  * vmaps: thousands of independent per-entity solves (random effects)
+    batch into one kernel, replacing Spark task-per-entity parallelism
+    (reference: SingleNodeOptimizationProblem run inside executor tasks);
+  * shard_maps: when the objective's data is sharded over a mesh axis, the
+    caller wraps value/grad in psum and this loop is unchanged (fixed
+    effects).
+
+Design notes
+------------
+- Two-loop recursion over rolling [m, d] history buffers with a pair counter;
+  pairs with non-positive curvature s.y are skipped (standard safeguard).
+- Backtracking Armijo line search on the *actual displacement* so box
+  projection (clamp-to-hypercube each trial point, reference:
+  OptimizationUtils.scala:40-70 projection used by LBFGS.scala:72) is
+  correct: acceptance tests f(P(x+t p)) <= f + c1 g.(P(x+t p) - x).
+- OWLQN (l1_weight > 0): Andrew & Gao pseudo-gradient steering, direction
+  sign-projection, orthant-constrained trial points, Armijo on
+  f + l1*|x|_1.  The l1 weight may be a scalar or per-coordinate array
+  (used to exempt the intercept).  L1 is a *traced* value: lambda sweeps
+  reuse one compiled program (the reference instead mutates a closure:
+  OWLQN.scala:81-86).
+- Defaults follow the reference: max_iterations=100, tolerance=1e-7, m=10
+  (LBFGS.scala:151-156).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optim.types import ConvergenceReason, SolveResult
+
+ValueAndGrad = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
+
+_C1 = 1e-4          # Armijo sufficient-decrease constant
+_MAX_LS = 30        # max backtracking halvings
+_CURV_EPS = 1e-12   # curvature-pair acceptance threshold
+
+
+class _State(NamedTuple):
+    k: jax.Array            # iteration counter
+    x: jax.Array            # [d]
+    f: jax.Array            # objective at x (incl. L1 term for OWLQN)
+    g: jax.Array            # raw gradient at x (no L1)
+    s_buf: jax.Array        # [m, d] displacement history
+    y_buf: jax.Array        # [m, d] gradient-difference history
+    rho: jax.Array          # [m] 1/(s.y)
+    num_pairs: jax.Array    # pairs stored so far
+    reason: jax.Array
+    loss_hist: jax.Array
+    gnorm_hist: jax.Array
+
+
+def _pseudo_gradient(x, g, l1):
+    """OWLQN pseudo-gradient of f + l1*|x|_1 (Andrew & Gao 2007)."""
+    gp = g + l1 * jnp.sign(x)
+    # at x_i == 0 the subgradient interval is [g-l1, g+l1]; steepest descent:
+    lo, hi = g - l1, g + l1
+    at_zero = jnp.where(hi < 0, hi, jnp.where(lo > 0, lo, 0.0))
+    return jnp.where(x != 0, gp, at_zero)
+
+
+def _two_loop(q, s_buf, y_buf, rho, num_pairs, m):
+    """Standard two-loop recursion with rolling buffers; slot i holds pair
+    (num_pairs-1-i) newest-first via modular indexing."""
+
+    def newest_first(i):
+        return (num_pairs - 1 - i) % m
+
+    def loop1(i, carry):
+        q, alphas = carry
+        j = newest_first(i)
+        valid = i < jnp.minimum(num_pairs, m)
+        a = jnp.where(valid, rho[j] * jnp.dot(s_buf[j], q), 0.0)
+        q = q - a * y_buf[j]
+        return q, alphas.at[i].set(a)
+
+    q, alphas = lax.fori_loop(0, m, loop1, (q, jnp.zeros((m,), q.dtype)))
+
+    # H0 scaling from newest valid pair
+    jn = newest_first(0)
+    have = num_pairs > 0
+    sy = jnp.dot(s_buf[jn], y_buf[jn])
+    yy = jnp.dot(y_buf[jn], y_buf[jn])
+    gamma = jnp.where(have & (yy > 0), sy / jnp.where(yy > 0, yy, 1.0), 1.0)
+    r = gamma * q
+
+    def loop2(i, r):
+        ii = m - 1 - i  # oldest stored first
+        j = newest_first(ii)
+        valid = ii < jnp.minimum(num_pairs, m)
+        b = jnp.where(valid, rho[j] * jnp.dot(y_buf[j], r), 0.0)
+        return r + jnp.where(valid, alphas[ii] - b, 0.0) * s_buf[j]
+
+    return lax.fori_loop(0, m, loop2, r)
+
+
+def lbfgs(
+    value_and_grad: ValueAndGrad,
+    x0: jax.Array,
+    *,
+    max_iterations: int = 100,
+    tolerance: float = 1e-7,
+    history: int = 10,
+    l1_weight: Optional[jax.Array | float] = None,
+    lower: Optional[jax.Array] = None,
+    upper: Optional[jax.Array] = None,
+    value_fn: Optional[Callable[[jax.Array], jax.Array]] = None,
+) -> SolveResult:
+    """Minimize f (+ optional l1*|x|_1, making this OWLQN) from x0.
+
+    `value_and_grad` must be the SMOOTH part only; L1 is handled in here via
+    pseudo-gradients exactly because it is non-smooth (reference:
+    OWLQN.scala).  `lower`/`upper` activate per-coordinate box projection
+    (reference: LBFGS.scala:72 + OptimizationUtils.scala:40-70); box and L1
+    are mutually exclusive, as in the reference.
+
+    `value_fn`, when given, is a cheaper value-only evaluation (no gradient
+    assembly) used for rejected line-search trials; the gradient is computed
+    once at the accepted point.
+    """
+    use_l1 = l1_weight is not None
+    use_box = lower is not None or upper is not None
+    if use_l1 and use_box:
+        raise ValueError("L1 (OWLQN) and box constraints cannot be combined "
+                         "(the reference has no such solver either)")
+    m = history
+    d = x0.shape[-1]
+    dtype = x0.dtype
+    l1 = jnp.asarray(l1_weight, dtype) if use_l1 else None
+
+    def project_box(x):
+        if not use_box:
+            return x
+        if lower is not None:
+            x = jnp.maximum(x, lower)
+        if upper is not None:
+            x = jnp.minimum(x, upper)
+        return x
+
+    def full_value(x):
+        """Value + gradient of the acceptance objective (smooth + L1 term)."""
+        v, g = value_and_grad(x)
+        if use_l1:
+            v = v + jnp.sum(l1 * jnp.abs(x))
+        return v, g
+
+    def trial_value(x):
+        """Value-only acceptance objective, skipping gradient assembly."""
+        v = value_fn(x) if value_fn is not None else value_and_grad(x)[0]
+        if use_l1:
+            v = v + jnp.sum(l1 * jnp.abs(x))
+        return v
+
+    x0 = project_box(x0)
+    f0, g0 = full_value(x0)
+    gnorm0 = jnp.linalg.norm(_pseudo_gradient(x0, g0, l1)) if use_l1 else jnp.linalg.norm(g0)
+    # relative gradient convergence, like breeze's default convergence check
+    gtol = tolerance * jnp.maximum(gnorm0, 1.0)
+
+    nan = jnp.asarray(jnp.nan, dtype)
+    init = _State(
+        k=jnp.asarray(0, jnp.int32),
+        x=x0, f=f0, g=g0,
+        s_buf=jnp.zeros((m, d), dtype), y_buf=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros((m,), dtype), num_pairs=jnp.asarray(0, jnp.int32),
+        reason=jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
+        loss_hist=jnp.full((max_iterations + 1,), nan).at[0].set(f0),
+        gnorm_hist=jnp.full((max_iterations + 1,), nan).at[0].set(gnorm0),
+    )
+
+    def cond(st: _State):
+        return (st.k < max_iterations) & (st.reason == ConvergenceReason.NOT_CONVERGED)
+
+    def body(st: _State) -> _State:
+        steer = _pseudo_gradient(st.x, st.g, l1) if use_l1 else st.g
+        p = -_two_loop(steer, st.s_buf, st.y_buf, st.rho, st.num_pairs, m)
+        if use_l1:
+            # direction must agree with -pseudo-gradient sign-wise
+            p = jnp.where(p * (-steer) > 0, p, 0.0)
+            orthant = jnp.where(st.x != 0, jnp.sign(st.x), jnp.sign(-steer))
+        dd = jnp.dot(steer, p)
+        # fall back to steepest descent if not a descent direction
+        bad = dd >= 0
+        p = jnp.where(bad, -steer, p)
+        dd = jnp.where(bad, -jnp.dot(steer, steer), dd)
+
+        # first iteration: scale so the first trial step is modest
+        t0 = jnp.where(st.num_pairs == 0,
+                       1.0 / jnp.maximum(jnp.linalg.norm(p), 1.0), 1.0)
+
+        def trial(t):
+            xt = st.x + t * p
+            if use_l1:
+                xt = jnp.where(xt * orthant > 0, xt, 0.0)
+            return project_box(xt)
+
+        def armijo_ok(xt, ft):
+            # Armijo on actual displacement (correct under projection)
+            return (ft <= st.f + _C1 * jnp.dot(steer, xt - st.x)) & jnp.isfinite(ft)
+
+        def ls_cond(c):
+            t, ls_iter, done, *_ = c
+            return (~done) & (ls_iter < _MAX_LS)
+
+        def ls_body(c):
+            t, ls_iter, _, _, _ = c
+            t = t * 0.5
+            xt = trial(t)
+            ft = trial_value(xt)
+            return t, ls_iter + 1, armijo_ok(xt, ft), xt, ft
+
+        xt0 = trial(t0)
+        ft0 = trial_value(xt0)
+        t, _, ls_ok, x_new, f_new = lax.while_loop(
+            ls_cond, ls_body,
+            (jnp.asarray(t0, dtype), jnp.asarray(0, jnp.int32),
+             armijo_ok(xt0, ft0), xt0, ft0))
+        # one fused value+grad at the accepted point only
+        _, g_new = value_and_grad(x_new)
+
+        # curvature pair from raw gradients (standard OWLQN choice)
+        s = x_new - st.x
+        yv = g_new - st.g
+        sy = jnp.dot(s, yv)
+        store = ls_ok & (sy > _CURV_EPS)
+        slot = st.num_pairs % m
+        s_buf = jnp.where(store, st.s_buf.at[slot].set(s), st.s_buf)
+        y_buf = jnp.where(store, st.y_buf.at[slot].set(yv), st.y_buf)
+        rho = jnp.where(store, st.rho.at[slot].set(1.0 / jnp.where(store, sy, 1.0)), st.rho)
+        num_pairs = st.num_pairs + jnp.where(store, 1, 0)
+
+        gnorm_new = (jnp.linalg.norm(_pseudo_gradient(x_new, g_new, l1))
+                     if use_l1 else jnp.linalg.norm(g_new))
+        # convergence checks (reference Optimizer.scala:136-150 reasons)
+        f_conv = jnp.abs(st.f - f_new) <= tolerance * jnp.maximum(
+            jnp.maximum(jnp.abs(st.f), jnp.abs(f_new)), 1.0)
+        g_conv = gnorm_new <= gtol
+        reason = jnp.where(
+            ~ls_ok, ConvergenceReason.LINE_SEARCH_FAILED,
+            jnp.where(g_conv, ConvergenceReason.GRADIENT_CONVERGED,
+                      jnp.where(f_conv, ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+                                ConvergenceReason.NOT_CONVERGED))).astype(jnp.int32)
+
+        # on line-search failure keep the previous iterate
+        x_new = jnp.where(ls_ok, x_new, st.x)
+        f_new = jnp.where(ls_ok, f_new, st.f)
+        g_new = jnp.where(ls_ok, g_new, st.g)
+        gnorm_new = jnp.where(ls_ok, gnorm_new, st.gnorm_hist[st.k])
+
+        k = st.k + 1
+        return _State(
+            k=k, x=x_new, f=f_new, g=g_new,
+            s_buf=s_buf, y_buf=y_buf, rho=rho, num_pairs=num_pairs,
+            reason=reason,
+            loss_hist=st.loss_hist.at[k].set(f_new),
+            gnorm_hist=st.gnorm_hist.at[k].set(gnorm_new),
+        )
+
+    st = lax.while_loop(cond, body, init)
+    reason = jnp.where(st.reason == ConvergenceReason.NOT_CONVERGED,
+                       jnp.asarray(ConvergenceReason.MAX_ITERATIONS, jnp.int32),
+                       st.reason)
+    gnorm_final = st.gnorm_hist[st.k]
+    return SolveResult(x=st.x, value=st.f, gradient_norm=gnorm_final,
+                       iterations=st.k, reason=reason,
+                       loss_history=st.loss_hist, gnorm_history=st.gnorm_hist)
+
+
+def owlqn(value_and_grad: ValueAndGrad, x0: jax.Array, *, l1_weight,
+          max_iterations: int = 100, tolerance: float = 1e-7,
+          history: int = 10) -> SolveResult:
+    """L1/elastic-net solver (reference: OWLQN.scala:40-86).  The L2 part of
+    elastic net lives in the smooth objective; only L1 comes through here."""
+    return lbfgs(value_and_grad, x0, max_iterations=max_iterations,
+                 tolerance=tolerance, history=history, l1_weight=l1_weight)

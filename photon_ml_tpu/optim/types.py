@@ -1,0 +1,67 @@
+"""Optimizer result/state types and convergence reasons.
+
+Rebuild of the reference's Optimizer state machinery:
+  - ConvergenceReason ADT (photon-lib/.../util/ConvergenceReason and
+    Optimizer.scala:136-150)
+  - OptimizationStatesTracker (photon-lib/.../optimization/
+    OptimizationStatesTracker.scala:32-102)
+
+Because solves run entirely inside jit (and often inside vmap, one solve per
+random-effect entity), the "tracker" is not a mutable queue but fixed-shape
+history arrays carried through the lax.while_loop and returned with the
+solution.  Histories are padded with NaN beyond the iteration count.
+"""
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+
+class ConvergenceReason(enum.IntEnum):
+    """int codes so they can live in traced arrays.
+
+    reference: Optimizer.scala:136-150 convergence reasons."""
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    LINE_SEARCH_FAILED = 4          # reference: ObjectiveNotImproving
+    TRUST_REGION_EXHAUSTED = 5      # TRON: max step-failures (TRON.scala:258)
+
+
+class SolveResult(NamedTuple):
+    """Solution + the states-tracker table.
+
+    `loss_history[i]` / `gnorm_history[i]` are the objective value and
+    gradient norm *entering* iteration i (so index 0 is the initial state,
+    matching the reference tracker's convergence table)."""
+
+    x: jax.Array
+    value: jax.Array
+    gradient_norm: jax.Array
+    iterations: jax.Array       # int32
+    reason: jax.Array           # int32 ConvergenceReason code
+    loss_history: jax.Array     # [max_iter + 1]
+    gnorm_history: jax.Array    # [max_iter + 1]
+
+    @property
+    def converged(self) -> jax.Array:
+        return (self.reason == ConvergenceReason.FUNCTION_VALUES_CONVERGED) | (
+            self.reason == ConvergenceReason.GRADIENT_CONVERGED)
+
+    def summary(self) -> str:
+        """Formatted convergence table (reference:
+        OptimizationStatesTracker.toString)."""
+        it = int(self.iterations)
+        lines = [f"{'iter':>5} {'loss':>18} {'|grad|':>14}"]
+        loss = np.asarray(self.loss_history)
+        gn = np.asarray(self.gnorm_history)
+        for i in range(it + 1):
+            lines.append(f"{i:>5} {loss[i]:>18.10e} {gn[i]:>14.6e}")
+        reason = ConvergenceReason(int(self.reason)).name
+        lines.append(f"converged after {it} iterations: {reason}")
+        return "\n".join(lines)

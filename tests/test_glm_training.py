@@ -1,0 +1,117 @@
+"""End-to-end single-device GLM training: sweep, warm start, normalization
+invariance, variances.
+
+Mirrors the reference's ModelTraining + GameEstimator normalization-invariance
+tests (GameEstimatorTest.scala:125-180): the final loss must be identical (to
+tolerance) across all normalization types because margins are invariant.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.models import train_glm
+from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective, build_normalization_context
+from photon_ml_tpu.optim import (
+    OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType,
+)
+from tests.synthetic import make_glm_data
+
+TASKS = ["logistic_regression", "linear_regression", "poisson_regression"]
+
+
+@pytest.mark.parametrize("task", TASKS)
+def test_sweep_monotone_loss_in_lambda(task, rng):
+    gen = {"logistic_regression": "logistic", "linear_regression": "linear",
+           "poisson_regression": "poisson"}[task]
+    x, y, _, _ = make_glm_data(rng, n=300, d=8, task=gen)
+    trained = train_glm(jnp.asarray(x), jnp.asarray(y), task,
+                        regularization=RegularizationContext(RegularizationType.L2),
+                        regularization_weights=[10.0, 1.0, 0.1])
+    assert [t.reg_weight for t in trained] == [10.0, 1.0, 0.1]
+    # unregularized data loss must decrease as lambda decreases
+    loss = TASK_LOSSES[task]
+    obj = GLMObjective(loss, jnp.asarray(x), jnp.asarray(y))
+    data_losses = [float(obj.value(t.model.coefficients.means)) for t in trained]
+    assert data_losses[0] >= data_losses[1] >= data_losses[2]
+    for t in trained:
+        assert t.model.validate_coefficients()
+
+
+@pytest.mark.parametrize("norm_type", ["none", "scale_with_standard_deviation",
+                                       "scale_with_max_magnitude", "standardization"])
+def test_normalization_invariance(norm_type, rng):
+    """Final original-space loss must agree across normalization types
+    (reference: GameEstimatorTest normalization invariance)."""
+    x, y, _, _ = make_glm_data(rng, n=400, d=6, task="logistic")
+    xj, yj = jnp.asarray(x), jnp.asarray(y)
+    norm = None
+    if norm_type != "none":
+        norm = build_normalization_context(
+            norm_type, mean=xj.mean(0), variance=xj.var(0, ddof=1),
+            max_magnitude=jnp.abs(xj).max(0), intercept_index=5)
+    trained = train_glm(xj, yj, "logistic_regression",
+                        normalization=norm,
+                        regularization_weights=[0.0],
+                        optimizer_config=OptimizerConfig(tolerance=1e-10,
+                                                         max_iterations=300))
+    obj = GLMObjective(TASK_LOSSES["logistic_regression"], xj, yj)
+    final = float(obj.value(trained[0].model.coefficients.means))
+    # the unregularized optimum is normalization-independent
+    baseline = float(obj.value(train_glm(xj, yj, "logistic_regression",
+                                         regularization_weights=[0.0],
+                                         optimizer_config=OptimizerConfig(
+                                             tolerance=1e-10, max_iterations=300)
+                                         )[0].model.coefficients.means))
+    assert abs(final - baseline) / max(1.0, abs(baseline)) < 1e-6
+
+
+def test_warm_start_reduces_iterations(rng):
+    x, y, _, _ = make_glm_data(rng, n=400, d=10, task="logistic")
+    kw = dict(regularization=RegularizationContext(RegularizationType.L2),
+              regularization_weights=[10.0, 5.0, 1.0, 0.5, 0.1])
+    warm = train_glm(jnp.asarray(x), jnp.asarray(y), "logistic_regression",
+                     warm_start=True, **kw)
+    cold = train_glm(jnp.asarray(x), jnp.asarray(y), "logistic_regression",
+                     warm_start=False, **kw)
+    # same optima
+    for w, c in zip(warm, cold):
+        np.testing.assert_allclose(w.result.value, c.result.value, rtol=1e-5)
+    assert (sum(int(t.result.iterations) for t in warm)
+            <= sum(int(t.result.iterations) for t in cold))
+
+
+def test_variances_match_inverse_hessian_diagonal(rng):
+    x, y, _, _ = make_glm_data(rng, n=300, d=5, task="linear")
+    trained = train_glm(jnp.asarray(x), jnp.asarray(y), "linear_regression",
+                        regularization_weights=[0.0], compute_variances=True)
+    v = trained[0].model.coefficients.variances
+    assert v is not None and v.shape == (5,)
+    # linear regression: diag(H) = diag(X^T X); variances ~ 1/diag
+    want = 1.0 / (np.sum(np.asarray(x) ** 2, axis=0) + 1e-12)
+    np.testing.assert_allclose(np.asarray(v), want, rtol=1e-10)
+
+
+def test_tron_and_lbfgs_reach_same_optimum(rng):
+    x, y, _, _ = make_glm_data(rng, n=300, d=6, task="poisson")
+    kw = dict(regularization=RegularizationContext(RegularizationType.L2),
+              regularization_weights=[1.0])
+    a = train_glm(jnp.asarray(x), jnp.asarray(y), "poisson_regression",
+                  optimizer_config=OptimizerConfig(optimizer=OptimizerType.LBFGS,
+                                                   tolerance=1e-9), **kw)
+    b = train_glm(jnp.asarray(x), jnp.asarray(y), "poisson_regression",
+                  optimizer_config=OptimizerConfig(optimizer=OptimizerType.TRON), **kw)
+    assert abs(float(a[0].result.value) - float(b[0].result.value)) < 1e-4
+
+
+def test_prediction_api(rng):
+    x, y, _, w_true = make_glm_data(rng, n=200, d=4, task="logistic")
+    m = train_glm(jnp.asarray(x), jnp.asarray(y), "logistic_regression",
+                  regularization_weights=[0.01],
+                  regularization=RegularizationContext(RegularizationType.L2))[0].model
+    p = np.asarray(m.predict(jnp.asarray(x)))
+    assert p.min() >= 0 and p.max() <= 1
+    acc = ((p > 0.5) == (y > 0.5)).mean()
+    bayes_acc = (((x @ w_true) > 0) == (y > 0.5)).mean()  # true-model accuracy
+    assert acc >= bayes_acc - 0.02
+    cls = np.asarray(m.predict_class(jnp.asarray(x)))
+    assert set(np.unique(cls)) <= {0, 1}

@@ -1,0 +1,173 @@
+"""Optimizer correctness: closed-form quadratics, GLM fits vs scipy,
+L1 sparsity, box constraints, jit/vmap compatibility.
+
+Mirrors the reference's optimizer suite (photon-lib/src/test/.../optimization/
+{OptimizerTest,LBFGSTest,OWLQNTest}.scala against TestObjective closed forms),
+plus TPU-specific requirements the reference never had: the whole solve must
+run under jit and vmap.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import minimize
+
+from photon_ml_tpu.ops import LOGISTIC, POISSON, SQUARED, GLMObjective
+from photon_ml_tpu.optim import (
+    ConvergenceReason, OptimizerConfig, OptimizerType, RegularizationContext,
+    RegularizationType, lbfgs, solve, tron,
+)
+from tests.synthetic import make_glm_data
+
+
+def _quad(center, scales):
+    """f(x) = 0.5 sum scales_i (x_i - center_i)^2 — the reference's
+    TestObjective style closed form."""
+    center = jnp.asarray(center)
+    scales = jnp.asarray(scales)
+
+    def vg(x):
+        return 0.5 * jnp.sum(scales * (x - center) ** 2), scales * (x - center)
+
+    def hv(x, v):
+        return scales * v
+
+    return vg, hv
+
+
+def test_lbfgs_quadratic_exact():
+    vg, _ = _quad([1.0, -2.0, 3.0], [1.0, 4.0, 0.5])
+    res = lbfgs(vg, jnp.zeros(3))
+    np.testing.assert_allclose(res.x, [1.0, -2.0, 3.0], atol=1e-5)
+    assert int(res.reason) in (ConvergenceReason.GRADIENT_CONVERGED,
+                               ConvergenceReason.FUNCTION_VALUES_CONVERGED)
+    # tracker: loss history is monotone non-increasing over recorded iters
+    lh = np.asarray(res.loss_history)[: int(res.iterations) + 1]
+    assert np.all(np.diff(lh) <= 1e-12)
+
+
+def test_tron_quadratic_exact():
+    vg, hv = _quad([1.0, -2.0, 3.0], [1.0, 4.0, 0.5])
+    res = tron(vg, hv, jnp.zeros(3))
+    np.testing.assert_allclose(res.x, [1.0, -2.0, 3.0], atol=1e-6)
+
+
+@pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.TRON])
+@pytest.mark.parametrize("loss,task", [(LOGISTIC, "logistic"), (SQUARED, "linear"),
+                                       (POISSON, "poisson")])
+def test_glm_fit_matches_scipy(opt, loss, task, rng):
+    x, y, w, _ = make_glm_data(rng, n=300, d=8, task=task, weight_range=(0.5, 2.0))
+    obj = GLMObjective(loss, jnp.asarray(x), jnp.asarray(y),
+                       weights=jnp.asarray(w), l2_weight=0.1)
+    res = solve(obj, jnp.zeros(8), OptimizerConfig(optimizer=opt),
+                RegularizationContext(RegularizationType.L2), 0.1)
+
+    ref = minimize(lambda c: tuple(np.asarray(v) for v in
+                                   obj.value_and_gradient(jnp.asarray(c))),
+                   np.zeros(8), jac=True, method="L-BFGS-B",
+                   options={"ftol": 1e-14, "gtol": 1e-10})
+    # same optimum, loss parity well within the 1e-4 target
+    assert abs(float(res.value) - ref.fun) / max(1.0, abs(ref.fun)) < 1e-6
+    np.testing.assert_allclose(res.x, ref.x, rtol=1e-3, atol=1e-4)
+
+
+def test_owlqn_produces_sparse_solution(rng):
+    x, y, _, _ = make_glm_data(rng, n=400, d=20, task="logistic")
+    # make half the features pure noise
+    x[:, 10:19] = rng.normal(size=(400, 9)) * 0.01
+    obj = GLMObjective(LOGISTIC, jnp.asarray(x), jnp.asarray(y))
+    res = solve(obj, jnp.zeros(20), OptimizerConfig(),
+                RegularizationContext(RegularizationType.L1), 5.0)
+    assert int(jnp.sum(res.x == 0.0)) >= 5, "L1 at lambda=5 should zero noise features"
+
+    # sanity: the L1 objective value must beat the zero vector
+    l1_obj = float(obj.value(res.x) + 5.0 * jnp.sum(jnp.abs(res.x)))
+    assert l1_obj < float(obj.value(jnp.zeros(20)))
+
+
+def test_owlqn_matches_unregularized_when_lambda_zero(rng):
+    x, y, _, _ = make_glm_data(rng, n=200, d=6, task="logistic")
+    obj = GLMObjective(LOGISTIC, jnp.asarray(x), jnp.asarray(y), l2_weight=0.05)
+    a = lbfgs(obj.value_and_gradient, jnp.zeros(6))
+    b = lbfgs(obj.value_and_gradient, jnp.zeros(6), l1_weight=0.0)
+    np.testing.assert_allclose(a.value, b.value, rtol=1e-8)
+
+
+def test_elastic_net_split(rng):
+    x, y, _, _ = make_glm_data(rng, n=200, d=10, task="logistic")
+    obj = GLMObjective(LOGISTIC, jnp.asarray(x), jnp.asarray(y))
+    reg = RegularizationContext(RegularizationType.ELASTIC_NET, elastic_net_alpha=0.5)
+    res = solve(obj, jnp.zeros(10), OptimizerConfig(), reg, 2.0)
+    # elastic net with alpha=.5, lambda=2: l1=1, l2=1 — compare against
+    # solving the same composite directly
+    res2 = lbfgs(obj.with_l2(1.0).value_and_gradient, jnp.zeros(10), l1_weight=1.0)
+    np.testing.assert_allclose(res.value, res2.value, rtol=1e-10)
+
+
+def test_box_constraints_respected_and_optimal(rng):
+    x, y, _, _ = make_glm_data(rng, n=300, d=5, task="linear")
+    obj = GLMObjective(SQUARED, jnp.asarray(x), jnp.asarray(y), l2_weight=0.01)
+    lower = jnp.asarray([-0.1, -0.1, -0.1, -0.1, -0.1])
+    upper = jnp.asarray([0.1, 0.1, 0.1, 0.1, 0.1])
+    res = lbfgs(obj.value_and_gradient, jnp.zeros(5), lower=lower, upper=upper)
+    assert bool(jnp.all(res.x >= lower - 1e-12)) and bool(jnp.all(res.x <= upper + 1e-12))
+
+    ref = minimize(lambda c: tuple(np.asarray(v) for v in
+                                   obj.value_and_gradient(jnp.asarray(c))),
+                   np.zeros(5), jac=True, method="L-BFGS-B",
+                   bounds=[(-0.1, 0.1)] * 5, options={"ftol": 1e-14})
+    assert float(res.value) <= ref.fun * (1 + 1e-5) + 1e-8
+
+
+def test_solve_under_jit_and_vmap(rng):
+    """The TPU contract: whole solves compile and batch.  This is what
+    replaces the reference's per-entity executor tasks."""
+    d = 4
+    xs, ys = [], []
+    for _ in range(8):
+        x, y, _, _ = make_glm_data(rng, n=50, d=d, task="logistic")
+        xs.append(x); ys.append(y)
+    xb = jnp.asarray(np.stack(xs))   # [8, 50, d]
+    yb = jnp.asarray(np.stack(ys))
+
+    def solve_one(x, y):
+        obj = GLMObjective(LOGISTIC, x, y, l2_weight=0.1)
+        return lbfgs(obj.value_and_gradient, jnp.zeros(d), max_iterations=50)
+
+    batched = jax.jit(jax.vmap(solve_one))(xb, yb)
+    assert batched.x.shape == (8, d)
+    # each batched solve must match its standalone solve
+    for i in range(8):
+        single = solve_one(xb[i], yb[i])
+        np.testing.assert_allclose(batched.x[i], single.x, rtol=1e-6, atol=1e-8)
+
+    # TRON under vmap too
+    def tron_one(x, y):
+        obj = GLMObjective(LOGISTIC, x, y, l2_weight=0.1)
+        return tron(obj.value_and_gradient, obj.hessian_vector, jnp.zeros(d))
+
+    tb = jax.jit(jax.vmap(tron_one))(xb, yb)
+    np.testing.assert_allclose(tb.x, batched.x, rtol=1e-3, atol=1e-4)
+
+
+def test_tron_rejects_l1_and_nonsmooth(rng):
+    from photon_ml_tpu.ops import SMOOTHED_HINGE
+    x, y, _, _ = make_glm_data(rng, n=50, d=3, task="logistic")
+    obj = GLMObjective(LOGISTIC, jnp.asarray(x), jnp.asarray(y))
+    with pytest.raises(ValueError):
+        solve(obj, jnp.zeros(3), OptimizerConfig(optimizer=OptimizerType.TRON),
+              RegularizationContext(RegularizationType.L1), 1.0)
+    obj_h = GLMObjective(SMOOTHED_HINGE, jnp.asarray(x), jnp.asarray(y))
+    with pytest.raises(ValueError):
+        solve(obj_h, jnp.zeros(3), OptimizerConfig(optimizer=OptimizerType.TRON))
+
+
+def test_smoothed_hinge_with_box_constraints(rng):
+    """BASELINE config #3: smoothed-hinge SVM with box-constrained coefs."""
+    from photon_ml_tpu.ops import SMOOTHED_HINGE
+    x, y, _, _ = make_glm_data(rng, n=300, d=6, task="hinge")
+    obj = GLMObjective(SMOOTHED_HINGE, jnp.asarray(x), jnp.asarray(y), l2_weight=0.01)
+    cfg = OptimizerConfig(box_lower=jnp.full(6, -0.5), box_upper=jnp.full(6, 0.5))
+    res = solve(obj, jnp.zeros(6), cfg)
+    assert bool(jnp.all(jnp.abs(res.x) <= 0.5 + 1e-12))
+    assert float(res.value) < float(obj.value(jnp.zeros(6)))
