@@ -86,8 +86,17 @@ class OptimizerConfig:
     tolerance: Optional[float] = None
     history: int = 10                     # LBFGS memory
     max_cg_iterations: int = 20           # TRON inner CG cap
-    box_lower: Optional[jax.Array] = None  # per-coordinate constraint map
-    box_upper: Optional[jax.Array] = None  # (reference: OptimizationUtils.scala)
+    # per-coordinate constraint maps (reference: OptimizationUtils.scala);
+    # stored as tuples so the config stays hashable — callers may pass any
+    # array-like and solve() converts back to arrays
+    box_lower: Optional[tuple] = None
+    box_upper: Optional[tuple] = None
+
+    def __post_init__(self):
+        for name in ("box_lower", "box_upper"):
+            v = getattr(self, name)
+            if v is not None and not isinstance(v, tuple):
+                object.__setattr__(self, name, tuple(float(e) for e in jnp.asarray(v)))
 
     def resolved(self) -> "OptimizerConfig":
         # explicit 0 / 0.0 are legitimate (e.g. tolerance=0 disables the
@@ -133,9 +142,11 @@ def solve(
                     max_iterations=cfg.max_iterations, tolerance=cfg.tolerance,
                     max_cg_iterations=cfg.max_cg_iterations)
 
+    lower = None if cfg.box_lower is None else jnp.asarray(cfg.box_lower, x0.dtype)
+    upper = None if cfg.box_upper is None else jnp.asarray(cfg.box_upper, x0.dtype)
     return lbfgs(obj.value_and_gradient, x0,
                  max_iterations=cfg.max_iterations, tolerance=cfg.tolerance,
                  history=cfg.history,
                  l1_weight=l1_w if reg.has_l1 else None,
-                 lower=cfg.box_lower, upper=cfg.box_upper,
+                 lower=lower, upper=upper,
                  value_fn=obj.value)

@@ -1,0 +1,11 @@
+from photon_ml_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS, FEATURE_AXIS, data_sharding, feature_sharding, make_mesh,
+    replicated, shard_leading,
+)
+from photon_ml_tpu.parallel.fixed_effect import (  # noqa: F401
+    fit_fixed_effect, pad_batch_to_mesh, score_fixed_effect, shard_objective,
+)
+from photon_ml_tpu.parallel.random_effect import (  # noqa: F401
+    EntityBlocks, fit_random_effects, random_effect_variances,
+    score_by_entity, score_entity_blocks,
+)

@@ -1,0 +1,86 @@
+"""Device-mesh construction and sharding helpers — the communication backend.
+
+This module is the TPU-native replacement for the reference's distributed
+runtime, which is Spark itself: broadcast of coefficients, treeAggregate of
+gradients/Hv, and keyed shuffles (SURVEY §2.15; reference:
+DistributedObjectiveFunction.scala:42-44, ValueAndGradientAggregator.scala:243-247,
+SparkContextConfiguration.scala).  Here the backend is XLA GSPMD over a
+`jax.sharding.Mesh`:
+
+  - per-iteration broadcast(w) disappears: coefficients are device-resident
+    and replicated by sharding annotation;
+  - treeAggregate becomes an ICI `psum` that XLA inserts when a sum over a
+    data-sharded axis produces a replicated result (tree-structured on the
+    torus natively — the reference's depth-2 tree for >200k features,
+    GameEstimator.scala:667-669, is subsumed);
+  - shuffles become static gathers planned at data-prep time.
+
+Mesh axes:
+  - "data":    batch rows (fixed effect) — pure data parallelism (P1);
+               also reused as the entity axis for random effects (P2), since
+               both shard the leading dimension of their arrays.
+  - "feature": optional second axis to shard very wide coefficient vectors
+               (the reference's feature-scaling axis, SURVEY §5.7): gradients
+               become reduce_scatter + all_gather rides ICI.
+
+Multi-host: jax.distributed + the same Mesh spanning hosts; DCN-spanning
+meshes put "data" outermost so gradient psums ride ICI within a slice and
+cross DCN once (hierarchical, like the reference's tree depth).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(num_data: Optional[int] = None, num_feature: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A (data, feature) mesh over the available devices.
+
+    Defaults to all devices on the data axis — the right layout for GLM
+    training where batch/entity sharding dominates and d is modest.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_data is None:
+        num_data = len(devices) // num_feature
+    if num_data * num_feature != len(devices):
+        raise ValueError(f"mesh {num_data}x{num_feature} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(num_data, num_feature)
+    return Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Leading axis split over "data", rest replicated — batches and entity
+    blocks."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def feature_sharding(mesh: Mesh) -> NamedSharding:
+    """[d] vectors split over the "feature" axis (wide fixed-effect models)."""
+    return NamedSharding(mesh, P(FEATURE_AXIS))
+
+
+def shard_leading(tree, mesh: Mesh):
+    """device_put every array leaf with its leading axis over "data".
+
+    The sharded-data equivalent of the reference's RDD partitioning; padding
+    to a multiple of mesh size is the data layer's job (see
+    photon_ml_tpu/data/batching.py).
+    """
+    def _put(leaf):
+        if leaf is None:
+            return None
+        if np.ndim(leaf) == 0:
+            return jax.device_put(leaf, replicated(mesh))  # scalars replicate
+        return jax.device_put(leaf, data_sharding(mesh, np.ndim(leaf)))
+    return jax.tree_util.tree_map(_put, tree)

@@ -1,0 +1,205 @@
+"""Random-effect training: vmapped per-entity solves, sharded over entities.
+
+Rebuild of strategy P2 (SURVEY §2.14) — the hard redesign.  The reference
+holds `RDD[(REId, LocalDataSet)]` co-partitioned with one optimizer instance
+and one GLM per entity, and runs a *local* Breeze solve per entity inside
+executor tasks (reference: RandomEffectCoordinate.scala:96-110,
+RandomEffectOptimizationProblem.scala:41, SingleNodeOptimizationProblem
+.scala:38).  That task-parallel, ragged formulation is hostile to TPUs.
+
+TPU design: entities are grouped at data-prep time into PADDED dense blocks
+  x[E, S, d], labels[E, S], mask[E, S]
+(S = per-bucket max sample count, capped by the reference's activeData upper
+bound, RandomEffectDataConfiguration), and the ENTIRE per-entity LBFGS/TRON
+solve runs under vmap: one batched XLA program performing E independent
+optimizations in lockstep, sharded over the mesh "data" axis.  Masked rows
+contribute nothing (aggregators use where()); entities finish at different
+iterations via the while_loop's per-lane convergence flags.  d here is the
+per-entity PROJECTED dimension (reference IndexMapProjector, §2.6): the data
+layer gathers each entity's observed features into a dense local space, which
+is what makes [E, S, d] compact.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from photon_ml_tpu.ops import GLMObjective
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim import OptimizerConfig, RegularizationContext, SolveResult, solve
+from photon_ml_tpu.parallel.mesh import data_sharding, replicated
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class EntityBlocks:
+    """Padded per-entity batches — the TPU replacement for
+    RDD[(REId, LocalDataSet)] (reference: RandomEffectDataSet.scala:47).
+
+    `entity_mask` marks real (vs padding) entities; `num_samples[e]` counts
+    real rows.  Entity ids live host-side in the data layer's
+    RandomEffectDataset, not here — blocks are pure device data.
+    """
+
+    x: jax.Array                    # [E, S, d]
+    labels: jax.Array               # [E, S]
+    mask: jax.Array                 # [E, S] 1.0 = real row
+    weights: Optional[jax.Array] = None   # [E, S]
+    offsets: Optional[jax.Array] = None   # [E, S]
+
+    def tree_flatten(self):
+        return (self.x, self.labels, self.mask, self.weights, self.offsets), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def num_entities(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def samples_per_entity(self) -> int:
+        return self.x.shape[1]
+
+    @property
+    def dim(self) -> int:
+        return self.x.shape[2]
+
+    @property
+    def entity_mask(self) -> jax.Array:
+        return (jnp.sum(self.mask, axis=1) > 0).astype(self.x.dtype)
+
+    def with_offsets(self, offsets: jax.Array) -> "EntityBlocks":
+        """Residual exchange for coordinate descent (reference:
+        DataSet.addScoresToOffsets) — an array assignment, not a shuffle."""
+        return dataclasses.replace(self, offsets=offsets)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_batched_solver(loss: PointwiseLoss, config: OptimizerConfig,
+                           reg: RegularizationContext, has_weights: bool,
+                           has_offsets: bool):
+    """Persistent jit-of-vmap per static signature: coordinate-descent
+    iterations reuse the compiled batched solve instead of retracing."""
+
+    def solve_one(x, labels, mask, weights, offsets, x0_e, lam):
+        obj = GLMObjective(loss, x, labels, weights=weights, offsets=offsets,
+                           mask=mask)
+        return solve(obj, x0_e, config, reg, lam)
+
+    return jax.jit(jax.vmap(solve_one,
+                            in_axes=(0, 0, 0, 0 if has_weights else None,
+                                     0 if has_offsets else None, 0, None)))
+
+
+def fit_random_effects(
+    blocks: EntityBlocks,
+    loss: PointwiseLoss,
+    mesh: Optional[Mesh] = None,
+    x0: Optional[jax.Array] = None,
+    config: OptimizerConfig = OptimizerConfig(),
+    reg: RegularizationContext = RegularizationContext(),
+    reg_weight: jax.Array | float = 0.0,
+) -> SolveResult:
+    """All per-entity solves as one batched program.
+
+    Returns a SolveResult whose leaves have a leading [E] axis
+    (x: [E, d], value: [E], ...).  The reference analogue is the 3-way join +
+    per-entity local optimize in RandomEffectCoordinate.updateModel
+    (RandomEffectCoordinate.scala:96-110); the regularization-weight plumbing
+    matches RandomEffectOptimizationProblem (one lambda shared by all
+    entities).
+    """
+    E, S, d = blocks.x.shape
+    dtype = blocks.x.dtype
+    if x0 is None:
+        x0 = jnp.zeros((E, d), dtype)
+    lam = jnp.asarray(reg_weight, dtype)
+
+    # auto-pad the entity axis to a mesh multiple with all-masked lanes
+    # (real datasets are rarely device-count multiples); results sliced back
+    pad_e = 0
+    if mesh is not None:
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS
+        pad_e = (-E) % mesh.shape[DATA_AXIS]
+    if pad_e:
+        zfill = lambda a, v: jnp.concatenate(
+            [a, jnp.full((pad_e,) + a.shape[1:], v, a.dtype)])
+        blocks = EntityBlocks(
+            zfill(blocks.x, 0.0), zfill(blocks.labels, 0.5), zfill(blocks.mask, 0.0),
+            None if blocks.weights is None else zfill(blocks.weights, 0.0),
+            None if blocks.offsets is None else zfill(blocks.offsets, 0.0))
+        x0 = zfill(x0, 0.0)
+
+    batched = _cached_batched_solver(loss, config, reg,
+                                     blocks.weights is not None,
+                                     blocks.offsets is not None)
+    if mesh is None:
+        return batched(blocks.x, blocks.labels, blocks.mask,
+                       blocks.weights, blocks.offsets, x0, lam)
+
+    put = lambda a: None if a is None else jax.device_put(a, data_sharding(mesh, a.ndim))
+    with mesh:
+        res = batched(put(blocks.x), put(blocks.labels), put(blocks.mask),
+                      put(blocks.weights), put(blocks.offsets), put(x0), lam)
+    if pad_e:
+        res = jax.tree_util.tree_map(lambda a: a[:E], res)
+    return res
+
+
+def score_entity_blocks(coefficients: jax.Array, blocks: EntityBlocks) -> jax.Array:
+    """Margins for every (entity, sample) cell: [E, S] = einsum over d.
+    Masked cells score 0.  reference: RandomEffectModel scoring of active
+    data (RandomEffectCoordinate.scala:148-165)."""
+    scores = jnp.einsum("esd,ed->es", blocks.x, coefficients)
+    if blocks.offsets is not None:
+        scores = scores + blocks.offsets
+    return scores * blocks.mask
+
+
+def score_by_entity(coefficients: jax.Array, x: jax.Array,
+                    entity_index: jax.Array) -> jax.Array:
+    """Score flat rows against their entity's model: one gather + row dot.
+
+    This replaces the reference's keyBy(REId) join of data against the model
+    RDD (RandomEffectModel.scala:256, passive-data scoring path
+    RandomEffectCoordinate.scala:178-210) with a static gather — the shuffle
+    was planned away at data-prep time by materializing `entity_index`.
+    Rows with entity_index == -1 (unseen entity) score 0, matching the
+    reference's missing-score default (Evaluator.scala:35-45).
+    """
+    num_entities = coefficients.shape[0]
+    in_range = (entity_index >= 0) & (entity_index < num_entities)
+    safe_idx = jnp.clip(entity_index, 0, num_entities - 1)
+    w = coefficients[safe_idx]                      # [n, d] gather
+    s = jnp.sum(x * w, axis=-1)
+    return jnp.where(in_range, s, 0.0)
+
+
+def random_effect_variances(
+    blocks: EntityBlocks, loss: PointwiseLoss, coefficients: jax.Array,
+    reg: RegularizationContext = RegularizationContext(),
+    reg_weight: jax.Array | float = 0.0,
+) -> jax.Array:
+    """Per-entity coefficient variances via vmapped Hessian diagonals
+    (reference: RandomEffectOptimizationProblem variance path).  Pass the
+    same reg/reg_weight used for training so the L2 term enters the
+    curvature (few-sample entities are otherwise wildly overestimated)."""
+    _, l2_w = reg.split(reg_weight)
+
+    def one(x, labels, mask, weights, offsets, c):
+        obj = GLMObjective(loss, x, labels, weights=weights, offsets=offsets,
+                           mask=mask, l2_weight=l2_w)
+        return 1.0 / (obj.hessian_diagonal(c) + 1e-12)
+
+    return jax.vmap(one, in_axes=(0, 0, 0,
+                                  None if blocks.weights is None else 0,
+                                  None if blocks.offsets is None else 0,
+                                  0))(blocks.x, blocks.labels, blocks.mask,
+                                      blocks.weights, blocks.offsets, coefficients)

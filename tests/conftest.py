@@ -7,8 +7,11 @@ math runs in float64 to match the all-double JVM reference.
 """
 import os
 
-# Force CPU: the session environment pins JAX_PLATFORMS to the (single,
-# tunneled) TPU chip, which would make every test a remote TPU compile.
+# Force CPU with 8 virtual devices: the session environment pins
+# JAX_PLATFORMS to the (single, tunneled) TPU chip, which would make every
+# test a remote TPU compile.  NOTE: a pytest plugin imports jax before this
+# conftest runs, so the env var alone is too late — use jax.config as well
+# (safe because no backend has been initialized yet at collection time).
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -16,6 +19,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: repeated test runs skip recompilation.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
