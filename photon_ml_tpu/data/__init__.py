@@ -1,0 +1,16 @@
+from photon_ml_tpu.data.batching import (  # noqa: F401
+    FixedEffectDataConfig, FixedEffectDataset, RandomEffectDataConfig,
+    RandomEffectDataset, build_random_effect_dataset,
+)
+from photon_ml_tpu.data.game_data import (  # noqa: F401
+    GameDataset, InputColumnNames, build_game_dataset,
+)
+from photon_ml_tpu.data.index_map import (  # noqa: F401
+    DELIMITER, INTERCEPT_KEY, INTERCEPT_NAME, IndexMap, IndexMapCollection,
+    build_index_map, feature_key,
+)
+from photon_ml_tpu.data.libsvm import read_libsvm  # noqa: F401
+from photon_ml_tpu.data.samplers import (  # noqa: F401
+    binary_classification_downsample, default_downsample, downsampler_for_task,
+)
+from photon_ml_tpu.data.stats import BasicStatisticalSummary  # noqa: F401

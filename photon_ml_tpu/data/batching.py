@@ -1,0 +1,252 @@
+"""Dataset -> device-block builders: the shuffle work, done once at prep time.
+
+Rebuild of the reference's per-coordinate dataset machinery:
+  - FixedEffectDataSet (photon-api/.../data/FixedEffectDataSet.scala:30-148)
+  - RandomEffectDataSet build: group-by-entity, per-entity sample cap with
+    weight rescaling, passive data, feature selection
+    (photon-api/.../data/RandomEffectDataSet.scala:240-472)
+  - LocalDataSet feature filtering (Pearson), local sampling
+    (photon-api/.../data/LocalDataSet.scala:36-321)
+  - IndexMapProjector: per-entity dense local feature space
+    (photon-api/.../projector/IndexMapProjectorRDD.scala:32-208)
+  - RandomEffectDataConfiguration / FixedEffectDataConfiguration
+    (photon-api/.../data/{RandomEffect,FixedEffect}DataConfiguration.scala)
+
+Where the reference shuffles (groupByKey by REId, MinHeap combineByKey for
+the reservoir cap) every time a dataset is built on the cluster, here the
+grouping/capping/projection run once on host numpy and emit static device
+blocks; the training loop touches only dense arrays after this point.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.parallel.random_effect import EntityBlocks
+
+_SAFE_LABEL = 0.5  # valid for every loss family; see pad_batch_to_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataConfig:
+    """reference: FixedEffectDataConfiguration.scala (featureShardId; the
+    minNumPartitions knob is meaningless here — sharding is the mesh's)."""
+
+    feature_shard: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfig:
+    """reference: RandomEffectDataConfiguration.scala:42-140.
+    `active_data_upper_bound` caps per-entity samples (reservoir-style, with
+    weight rescaling); rows beyond the cap become passive data (scored, not
+    trained on) when the entity has more than `passive_data_lower_bound`
+    rows.  `features_to_samples_ratio` triggers per-entity Pearson feature
+    selection.  `projector` in {"index_map", "identity"}."""
+
+    random_effect_type: str
+    feature_shard: str
+    active_data_upper_bound: Optional[int] = None
+    passive_data_lower_bound: Optional[int] = None
+    features_to_samples_ratio: Optional[float] = None
+    projector: str = "index_map"
+    seed: int = 7
+
+
+@dataclasses.dataclass
+class FixedEffectDataset:
+    """Flat [n] arrays for one shard, canonical row order."""
+
+    x: np.ndarray
+    labels: np.ndarray
+    weights: Optional[np.ndarray]
+    offsets: Optional[np.ndarray]
+    feature_shard: str
+
+    @staticmethod
+    def build(dataset: GameDataset, config: FixedEffectDataConfig) -> "FixedEffectDataset":
+        return FixedEffectDataset(
+            x=dataset.feature_shards[config.feature_shard],
+            labels=dataset.response,
+            weights=dataset.weights,
+            offsets=dataset.offsets,
+            feature_shard=config.feature_shard)
+
+
+def _pearson_select(x: np.ndarray, y: np.ndarray, keep: int) -> np.ndarray:
+    """Top-`keep` columns by |Pearson correlation with the label|; constant
+    columns (e.g. the intercept) score epsilon but are ranked last only among
+    themselves — the intercept is re-added by the caller.
+    reference: LocalDataSet.computePearsonCorrelationScore (line 221-288)."""
+    xc = x - x.mean(axis=0, keepdims=True)
+    yc = y - y.mean()
+    sx = np.sqrt((xc * xc).sum(axis=0))
+    sy = np.sqrt((yc * yc).sum())
+    denom = sx * sy
+    corr = np.where(denom > 0, np.abs(xc.T @ yc) / np.where(denom > 0, denom, 1.0), 0.0)
+    return np.argsort(-corr, kind="stable")[:keep]
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """Per-entity training blocks + the index plumbing to score flat rows.
+
+    reference: RandomEffectDataSet (activeData + uniqueId->REId map +
+    passiveData) — here the "joins" are materialized index arrays:
+      - entity_position[v]: vocab entity v -> block lane (-1 if unseen)
+      - active_row_ids[e, s]: block cell -> canonical row id (-1 pad), which
+        also realizes addScoresToOffsets as one gather
+    """
+
+    config: RandomEffectDataConfig
+    blocks: EntityBlocks
+    entity_ids: np.ndarray          # [E] vocab indices, block lane order
+    entity_position: np.ndarray     # [V] vocab index -> block lane or -1
+    active_row_ids: np.ndarray      # [E, S] canonical row ids, -1 = padding
+    projection: Optional[np.ndarray]  # [E, d_local] global col ids, -1 pad
+    global_dim: int
+    num_active: int
+    num_passive: int
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_ids)
+
+    @property
+    def local_dim(self) -> int:
+        return self.blocks.dim
+
+    def with_offsets_from_flat(self, flat_offsets) -> EntityBlocks:
+        """addScoresToOffsets (reference: RandomEffectDataSet.scala:68-88):
+        gather the canonical-order offset vector into block layout."""
+        flat = jnp.asarray(flat_offsets)
+        safe = jnp.maximum(jnp.asarray(self.active_row_ids), 0)
+        off = flat[safe] * jnp.asarray(self.blocks.mask)
+        return self.blocks.with_offsets(off.astype(self.blocks.x.dtype))
+
+    def scatter_to_global(self, local_coefficients) -> jnp.ndarray:
+        """[E, d_local] local-space coefficients -> [E, d_global]
+        (reference: IndexMapProjector.projectCoefficients)."""
+        from photon_ml_tpu.parallel.random_effect import scatter_local_to_global
+        return scatter_local_to_global(jnp.asarray(local_coefficients),
+                                       self.projection, self.global_dim)
+
+    def flat_entity_lanes(self, entity_index: np.ndarray) -> np.ndarray:
+        """Map a canonical-order entity-index column to block lanes."""
+        idx = np.asarray(entity_index)
+        lanes = np.full_like(idx, -1)
+        valid = idx >= 0
+        lanes[valid] = self.entity_position[idx[valid]]
+        return lanes
+
+
+def build_random_effect_dataset(
+    dataset: GameDataset,
+    config: RandomEffectDataConfig,
+    dtype=np.float64,
+) -> RandomEffectDataset:
+    """Group-by-entity -> cap -> select features -> project -> pad.
+
+    reference call path: RandomEffectDataSet.apply (scala:240-277) +
+    featureSelectionOnActiveData (scala:457-471) +
+    RandomEffectDataSetInProjectedSpace.buildWithProjectorType."""
+    re_type = config.random_effect_type
+    x_flat = np.asarray(dataset.feature_shards[config.feature_shard], dtype=dtype)
+    y_flat = np.asarray(dataset.response, dtype=dtype)
+    w_flat = None if dataset.weights is None else np.asarray(dataset.weights, dtype)
+    o_flat = None if dataset.offsets is None else np.asarray(dataset.offsets, dtype)
+    ent = np.asarray(dataset.entity_indices[re_type])
+    n, d_global = x_flat.shape
+    rng = np.random.default_rng(config.seed)
+
+    present = ent >= 0
+    uniq = np.unique(ent[present])
+    E = len(uniq)
+    entity_position = np.full(dataset.num_entities(re_type), -1, dtype=np.int64)
+    entity_position[uniq] = np.arange(E)
+
+    # group rows per entity (one argsort — the groupByKey replacement)
+    order = np.argsort(ent[present], kind="stable")
+    rows_present = np.nonzero(present)[0][order]
+    counts = np.bincount(entity_position[ent[present]], minlength=E)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+
+    cap = config.active_data_upper_bound
+    num_passive = 0
+    active_rows_per_entity = []
+    weight_scale = np.ones(E)
+    for e in range(E):
+        rows_e = rows_present[starts[e]: starts[e] + counts[e]]
+        if cap is not None and len(rows_e) > cap:
+            keep = rng.choice(len(rows_e), size=cap, replace=False)
+            lower = config.passive_data_lower_bound
+            if lower is None or len(rows_e) > lower:
+                num_passive += len(rows_e) - cap
+            # weight rescale so the capped sample represents the full count
+            # (reference: MinHeapWithFixedCapacity cumCount/size rescale,
+            # RandomEffectDataSet.scala:325-388)
+            weight_scale[e] = len(rows_e) / cap
+            rows_e = rows_e[np.sort(keep)]
+        active_rows_per_entity.append(rows_e)
+
+    S = max((len(r) for r in active_rows_per_entity), default=1)
+    active_row_ids = np.full((E, S), -1, dtype=np.int64)
+    for e, rows_e in enumerate(active_rows_per_entity):
+        active_row_ids[e, : len(rows_e)] = rows_e
+    mask = (active_row_ids >= 0).astype(dtype)
+    safe_ids = np.maximum(active_row_ids, 0)
+
+    # per-entity feature projection (index-map projector): observed columns
+    projection = None
+    if config.projector == "index_map":
+        col_lists = []
+        ratio = config.features_to_samples_ratio
+        intercept_col = d_global - 1  # intercept-last convention (IndexMap)
+        for e, rows_e in enumerate(active_rows_per_entity):
+            observed = np.nonzero(np.any(x_flat[rows_e] != 0, axis=0))[0]
+            if ratio is not None and len(observed) > ratio * max(len(rows_e), 1):
+                keep = int(np.ceil(ratio * max(len(rows_e), 1)))
+                has_intercept = intercept_col in observed
+                cand = observed[observed != intercept_col] if has_intercept else observed
+                sel = _pearson_select(x_flat[rows_e][:, cand], y_flat[rows_e],
+                                      max(keep - int(has_intercept), 1))
+                chosen = cand[sel]
+                if has_intercept:  # the intercept always survives selection
+                    chosen = np.concatenate([chosen, [intercept_col]])
+                observed = np.sort(chosen)
+            col_lists.append(observed)
+        d_local = max((len(c) for c in col_lists), default=1)
+        projection = np.full((E, d_local), -1, dtype=np.int64)
+        for e, colse in enumerate(col_lists):
+            projection[e, : len(colse)] = colse
+        # gather features into local spaces: x_blocks[e, s, j] = x[row, proj[e, j]]
+        x_blocks = np.zeros((E, S, d_local), dtype=dtype)
+        for e in range(E):
+            cols = projection[e]
+            valid_cols = cols >= 0
+            x_blocks[e][:, valid_cols] = x_flat[safe_ids[e]][:, cols[valid_cols]]
+        x_blocks *= mask[:, :, None]
+    elif config.projector == "identity":
+        x_blocks = x_flat[safe_ids] * mask[:, :, None]
+    else:
+        raise ValueError(f"unknown projector {config.projector!r} "
+                         "(expected 'index_map' or 'identity')")
+
+    labels = np.where(mask > 0, y_flat[safe_ids], _SAFE_LABEL)
+    weights = (w_flat[safe_ids] if w_flat is not None else np.ones((E, S), dtype))
+    weights = weights * mask * weight_scale[:, None]
+    offsets = None if o_flat is None else o_flat[safe_ids] * mask
+
+    blocks = EntityBlocks(
+        x=jnp.asarray(x_blocks), labels=jnp.asarray(labels),
+        mask=jnp.asarray(mask), weights=jnp.asarray(weights),
+        offsets=None if offsets is None else jnp.asarray(offsets))
+    return RandomEffectDataset(
+        config=config, blocks=blocks, entity_ids=uniq,
+        entity_position=entity_position, active_row_ids=active_row_ids,
+        projection=projection, global_dim=d_global,
+        num_active=int(mask.sum()), num_passive=num_passive)

@@ -1,0 +1,126 @@
+"""GAME dataset: struct-of-arrays with a fixed canonical row order.
+
+Rebuild of the reference's data containers:
+  - GameDatum (photon-lib/.../data/GameDatum.scala:38-70): per-row
+    (response, offset, weight, per-shard features, id tags)
+  - GameConverters (photon-api/.../data/GameConverters.scala:29-171):
+    DataFrame -> RDD[(uid, GameDatum)] with monotonically_increasing_id
+  - FixedEffectDataSet (photon-api/.../data/FixedEffectDataSet.scala:30-148)
+  - InputColumnsNames (photon-api/.../data/InputColumnsNames.scala)
+
+Key TPU design decision (SURVEY §7 "Score bookkeeping"): the uid IS the row
+position.  Every coordinate keeps its scores as a dense [n] device array in
+this canonical order, so CoordinateDescent's add/subtract-scores joins
+(reference: DataScores +/- via full outer joins, CoordinateDataScores
+.scala:38-61) become elementwise array ops.  Entity membership per random
+effect type is materialized once at ingest as an int index column
+(`entity_index[re_type][row]`), which turns every keyBy(REId) shuffle of the
+reference into a static gather.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap
+
+
+@dataclasses.dataclass
+class InputColumnNames:
+    """Remappable input column names (reference: InputColumnsNames.scala)."""
+
+    response: str = "response"
+    offset: str = "offset"
+    weight: str = "weight"
+    uid: str = "uid"
+
+
+@dataclasses.dataclass
+class GameDataset:
+    """n rows in canonical order; everything else hangs off row position."""
+
+    response: np.ndarray                       # [n] float
+    feature_shards: Dict[str, np.ndarray]      # shard -> [n, d_shard] float
+    offsets: Optional[np.ndarray] = None       # [n]
+    weights: Optional[np.ndarray] = None       # [n]
+    # re_type -> [n] int index into entity_vocabs[re_type]; -1 = missing id
+    entity_indices: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    # re_type -> [num_entities] entity id strings (row i of a RandomEffect
+    # model belongs to entity_vocabs[re_type][i])
+    entity_vocabs: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    index_maps: Dict[str, IndexMap] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        n = len(self.response)
+        for shard, x in self.feature_shards.items():
+            if x.shape[0] != n:
+                raise ValueError(f"shard {shard!r} has {x.shape[0]} rows, expected {n}")
+        for re_type, idx in self.entity_indices.items():
+            if len(idx) != n:
+                raise ValueError(f"entity index {re_type!r} has {len(idx)} rows, expected {n}")
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.response)
+
+    def num_entities(self, re_type: str) -> int:
+        return len(self.entity_vocabs[re_type])
+
+    def shard_dim(self, shard: str) -> int:
+        return self.feature_shards[shard].shape[1]
+
+    def subset(self, rows: np.ndarray) -> "GameDataset":
+        """Row slice sharing vocabularies (for train/validation splits)."""
+        take = lambda a: None if a is None else a[rows]
+        return GameDataset(
+            response=self.response[rows],
+            feature_shards={s: x[rows] for s, x in self.feature_shards.items()},
+            offsets=take(self.offsets),
+            weights=take(self.weights),
+            entity_indices={t: idx[rows] for t, idx in self.entity_indices.items()},
+            entity_vocabs=self.entity_vocabs,
+            index_maps=self.index_maps,
+        )
+
+
+def build_game_dataset(
+    response: np.ndarray,
+    feature_shards: Dict[str, np.ndarray],
+    *,
+    offsets: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    entity_ids: Optional[Dict[str, np.ndarray]] = None,
+    entity_vocabs: Optional[Dict[str, np.ndarray]] = None,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+) -> GameDataset:
+    """GameConverters equivalent: raw id columns -> indexed entity columns.
+
+    `entity_ids[re_type]` is a [n] array of raw ids (strings/ints); ids are
+    interned into a vocabulary (sorted for determinism) unless a shared
+    vocab is supplied (scoring against a trained model's entity space, where
+    unseen ids must map to -1 — the reference's passive/missing-score path).
+    """
+    entity_indices, vocabs = {}, {}
+    for re_type, ids in (entity_ids or {}).items():
+        ids = np.asarray(ids)
+        if entity_vocabs and re_type in entity_vocabs:
+            vocab = np.asarray(entity_vocabs[re_type])
+            lookup = {v: i for i, v in enumerate(vocab.tolist())}
+            idx = np.asarray([lookup.get(v, -1) for v in ids.tolist()],
+                             dtype=np.int32)
+        else:
+            vocab, idx = np.unique(ids, return_inverse=True)
+            idx = idx.astype(np.int32)
+        entity_indices[re_type] = idx
+        vocabs[re_type] = vocab
+    return GameDataset(
+        response=np.asarray(response, dtype=np.float64),
+        feature_shards={s: np.asarray(x) for s, x in feature_shards.items()},
+        offsets=None if offsets is None else np.asarray(offsets, dtype=np.float64),
+        weights=None if weights is None else np.asarray(weights, dtype=np.float64),
+        entity_indices=entity_indices,
+        entity_vocabs=vocabs,
+        index_maps=index_maps or {},
+    )

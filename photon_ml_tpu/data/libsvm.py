@@ -1,0 +1,52 @@
+"""LIBSVM text reader.
+
+reference: photon-client/.../io/deprecated/LibSVMInputDataFormat.scala (legacy
+LIBSVM -> RDD[LabeledPoint]) and dev-scripts/libsvm_text_to_trainingexample_avro.py
+(the a1a conversion path in the reference README's "Try It Out!").
+
+Returns dense or scipy-CSR host arrays; densify is the right call for
+a1a-scale d (123 features) where the TPU wants one [n, d] matmul."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def read_libsvm(
+    path: str,
+    num_features: Optional[int] = None,
+    add_intercept: bool = True,
+    zero_based: bool = False,
+    binary_labels_to_01: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """-> (X [n, d(+1)], y [n]).  The intercept column (all ones) is appended
+    LAST, matching IndexMap's intercept-last convention."""
+    rows, cols, vals, labels = [], [], [], []
+    max_col = -1
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                if tok.startswith("#"):
+                    break
+                idx_s, _, val_s = tok.partition(":")
+                j = int(idx_s) - (0 if zero_based else 1)
+                rows.append(len(labels) - 1)
+                cols.append(j)
+                vals.append(float(val_s))
+                max_col = max(max_col, j)
+    n = len(labels)
+    d = num_features if num_features is not None else max_col + 1
+    x = np.zeros((n, d + (1 if add_intercept else 0)))
+    x[np.asarray(rows, dtype=np.int64), np.asarray(cols, dtype=np.int64)] = vals
+    if add_intercept:
+        x[:, -1] = 1.0
+    y = np.asarray(labels)
+    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y > 0).astype(np.float64)  # ±1 -> {0,1}, the API's label space
+    return x, y

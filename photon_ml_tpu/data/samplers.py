@@ -1,0 +1,59 @@
+"""Down-samplers for fixed-effect updates.
+
+reference: photon-lib/.../sampler/{DownSampler,BinaryClassificationDownSampler,
+DefaultDownSampler}.scala:33-69, applied per fixed-effect update at
+DistributedOptimizationProblem.runWithSampling:143.
+
+TPU design (SURVEY §2.14 P6): no data movement — down-sampling is a weight
+mask computed from a PRNG key.  Kept negatives get weight / rate so the
+gradient stays unbiased, exactly the reference's rescale.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def binary_classification_downsample(
+    key: jax.Array,
+    labels: jax.Array,
+    weights: Optional[jax.Array],
+    rate: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Keep all positives; keep negatives w.p. `rate` with weight 1/rate.
+
+    Returns (mask, weights).  reference:
+    BinaryClassificationDownSampler.scala:47-68."""
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0, 1), got {rate}")
+    w = jnp.ones_like(labels) if weights is None else weights
+    u = jax.random.uniform(key, labels.shape, dtype=labels.dtype)
+    is_pos = labels > 0.5
+    keep = is_pos | (u < rate)
+    new_w = jnp.where(is_pos, w, w / rate)
+    return keep.astype(labels.dtype), new_w
+
+
+def default_downsample(
+    key: jax.Array,
+    labels: jax.Array,
+    weights: Optional[jax.Array],
+    rate: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Uniform row sampling with 1/rate weight rescale (regression tasks).
+    reference: DefaultDownSampler.scala."""
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0, 1), got {rate}")
+    w = jnp.ones_like(labels) if weights is None else weights
+    u = jax.random.uniform(key, labels.shape, dtype=labels.dtype)
+    keep = u < rate
+    return keep.astype(labels.dtype), w / rate
+
+
+def downsampler_for_task(task_type: str):
+    """reference: DownSampler factory choice in DistributedOptimizationProblem."""
+    if task_type in ("logistic_regression", "smoothed_hinge_loss_linear_svm"):
+        return binary_classification_downsample
+    return default_downsample

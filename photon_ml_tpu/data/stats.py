@@ -1,0 +1,55 @@
+"""Per-feature summary statistics.
+
+reference: BasicStatisticalSummary (photon-lib/.../stat/
+BasicStatisticalSummary.scala:36-117), which wraps spark-mllib colStats.
+Used to build NormalizationContexts and for the feature-stats output file
+(reference: cli/game/training/Driver.calculateAndSaveFeatureShardStats).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BasicStatisticalSummary:
+    mean: np.ndarray
+    variance: np.ndarray
+    count: int
+    num_nonzeros: np.ndarray
+    max: np.ndarray
+    min: np.ndarray
+    norm_l1: np.ndarray
+    norm_l2: np.ndarray
+    mean_abs: np.ndarray
+
+    @property
+    def max_magnitude(self) -> np.ndarray:
+        return np.maximum(np.abs(self.max), np.abs(self.min))
+
+    @staticmethod
+    def from_features(x: np.ndarray, weights: Optional[np.ndarray] = None
+                      ) -> "BasicStatisticalSummary":
+        x = np.asarray(x)
+        n = x.shape[0]
+        if weights is None:
+            mean = x.mean(axis=0)
+            var = x.var(axis=0, ddof=1) if n > 1 else np.zeros(x.shape[1])
+        else:
+            w = np.asarray(weights)[:, None]
+            wsum = w.sum()
+            mean = (x * w).sum(axis=0) / wsum
+            var = ((x - mean) ** 2 * w).sum(axis=0) / max(wsum - 1.0, 1.0)
+        return BasicStatisticalSummary(
+            mean=mean, variance=var, count=n,
+            num_nonzeros=(x != 0).sum(axis=0),
+            max=x.max(axis=0), min=x.min(axis=0),
+            norm_l1=np.abs(x).sum(axis=0),
+            norm_l2=np.sqrt((x * x).sum(axis=0)),
+            mean_abs=np.abs(x).mean(axis=0))
+
+    def to_dict(self) -> Dict[str, list]:
+        return {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                for k, v in dataclasses.asdict(self).items()}

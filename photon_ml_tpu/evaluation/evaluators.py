@@ -1,0 +1,185 @@
+"""Evaluation metrics: AUC, RMSE, per-task losses, grouped multi-evaluators.
+
+Rebuild of the reference evaluation stack:
+  - Evaluator trait + score+offset semantics, missing score -> 0
+    (photon-lib/.../evaluation/Evaluator.scala:22-76)
+  - EvaluatorType parsing incl. "precision@k:10:queryId" style
+    (photon-lib/.../evaluation/EvaluatorType.scala, MultiEvaluatorType.scala)
+  - AreaUnderROCCurveEvaluator (+Local), RMSEEvaluator, loss evaluators
+    (photon-api/.../evaluation/*.scala)
+  - MultiEvaluator: group scores by an id column, evaluate per group,
+    average the finite results (MultiEvaluator.scala:38-65)
+
+AUC is the rank-statistic (Mann-Whitney) formulation — one sort, tie-aware —
+rather than the reference's threshold sweep; identical value, TPU-friendly.
+Grouped metrics use one lexicographic argsort + contiguous group slices.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.ops import losses as L
+
+
+def _np(a):
+    return np.asarray(a, dtype=np.float64)
+
+
+def area_under_roc_curve(scores, labels, weights=None) -> float:
+    """Tie-aware weighted AUC via midranks.  NaN when one class is absent
+    (the reference returns NaN for undefined metrics; MultiEvaluator then
+    drops the group)."""
+    s, y = _np(scores), _np(labels)
+    w = np.ones_like(s) if weights is None else _np(weights)
+    pos = y > 0.5
+    wp, wn = w[pos].sum(), w[~pos].sum()
+    if wp == 0 or wn == 0:
+        return float("nan")
+    order = np.argsort(s, kind="stable")
+    s_sorted, w_sorted, pos_sorted = s[order], w[order], pos[order]
+    # AUC = sum over score tie-groups G of  wp_G * (wn_below_G + wn_G/2),
+    # normalized by wp*wn  — i.e. P(s+ > s-) + P(s+ == s-)/2, weighted.
+    bounds = np.concatenate([[0], np.nonzero(np.diff(s_sorted))[0] + 1])
+    wp_g = np.add.reduceat(np.where(pos_sorted, w_sorted, 0.0), bounds)
+    wn_g = np.add.reduceat(np.where(~pos_sorted, w_sorted, 0.0), bounds)
+    wn_below = np.concatenate([[0.0], np.cumsum(wn_g)[:-1]])
+    return float(np.sum(wp_g * (wn_below + 0.5 * wn_g)) / (wp * wn))
+
+
+def rmse(scores, labels, weights=None) -> float:
+    s, y = _np(scores), _np(labels)
+    w = np.ones_like(s) if weights is None else _np(weights)
+    return float(np.sqrt(np.sum(w * (s - y) ** 2) / np.sum(w)))
+
+
+def _loss_metric(loss: L.PointwiseLoss):
+    def fn(scores, labels, weights=None) -> float:
+        import jax.numpy as jnp
+        z, y = jnp.asarray(_np(scores)), jnp.asarray(_np(labels))
+        l = loss.loss(z, y)
+        w = jnp.ones_like(z) if weights is None else jnp.asarray(_np(weights))
+        return float(jnp.sum(w * l) / jnp.sum(w))
+    return fn
+
+
+def precision_at_k(k: int, scores, labels, weights=None) -> float:
+    s, y = _np(scores), _np(labels)
+    top = np.argsort(-s, kind="stable")[:k]
+    return float((y[top] > 0.5).mean())
+
+
+@dataclasses.dataclass(frozen=True)
+class Evaluator:
+    """name + metric + direction.  reference: Evaluator.betterThan."""
+
+    name: str
+    fn: Callable
+    larger_is_better: bool
+
+    def __call__(self, scores, labels, weights=None) -> float:
+        return self.fn(scores, labels, weights)
+
+    def better_than(self, a: float, b: float) -> bool:
+        if np.isnan(a):
+            return False
+        if np.isnan(b):
+            return True
+        return a > b if self.larger_is_better else a < b
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiEvaluator:
+    """Grouped metric: evaluate per id-group, average finite results.
+
+    reference: MultiEvaluator.scala:49-64 (groupByKey + LocalEvaluator per
+    group + mean of finite values).  `group_index` is a canonical-order int
+    column (an entity_indices column of the GameDataset)."""
+
+    name: str
+    local: Callable  # (scores, labels, weights) -> float
+    larger_is_better: bool
+    min_group_size: int = 1
+
+    def evaluate_grouped(self, group_index, scores, labels, weights=None) -> float:
+        g = np.asarray(group_index)
+        s, y = _np(scores), _np(labels)
+        w = None if weights is None else _np(weights)
+        valid = g >= 0
+        order = np.argsort(g[valid], kind="stable")
+        gv, sv, yv = g[valid][order], s[valid][order], y[valid][order]
+        wv = None if w is None else w[valid][order]
+        bounds = np.concatenate([[0], np.nonzero(np.diff(gv))[0] + 1, [len(gv)]])
+        vals = []
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            if b - a < self.min_group_size:
+                continue
+            v = self.local(sv[a:b], yv[a:b], None if wv is None else wv[a:b])
+            if np.isfinite(v):
+                vals.append(v)
+        return float(np.mean(vals)) if vals else float("nan")
+
+    def better_than(self, a: float, b: float) -> bool:
+        if np.isnan(a):
+            return False
+        if np.isnan(b):
+            return True
+        return a > b if self.larger_is_better else a < b
+
+
+AUC = Evaluator("AUC", area_under_roc_curve, larger_is_better=True)
+RMSE = Evaluator("RMSE", rmse, larger_is_better=False)
+LOGISTIC_LOSS = Evaluator("LOGISTIC_LOSS", _loss_metric(L.LOGISTIC), larger_is_better=False)
+SQUARED_LOSS = Evaluator("SQUARED_LOSS", _loss_metric(L.SQUARED), larger_is_better=False)
+POISSON_LOSS = Evaluator("POISSON_LOSS", _loss_metric(L.POISSON), larger_is_better=False)
+SMOOTHED_HINGE_LOSS = Evaluator("SMOOTHED_HINGE_LOSS", _loss_metric(L.SMOOTHED_HINGE),
+                                larger_is_better=False)
+
+_BY_NAME = {e.name: e for e in (AUC, RMSE, LOGISTIC_LOSS, SQUARED_LOSS,
+                                POISSON_LOSS, SMOOTHED_HINGE_LOSS)}
+
+
+def default_evaluator_for_task(task_type: str) -> Evaluator:
+    """reference: GameEstimator.prepareTrainingLossEvaluator task mapping."""
+    return {
+        "logistic_regression": LOGISTIC_LOSS,
+        "linear_regression": SQUARED_LOSS,
+        "poisson_regression": POISSON_LOSS,
+        "smoothed_hinge_loss_linear_svm": SMOOTHED_HINGE_LOSS,
+    }[task_type]
+
+
+def default_validation_evaluator_for_task(task_type: str) -> Evaluator:
+    """reference: Driver default validation metric per task (AUC for
+    classification, RMSE for linear, PoissonLoss for poisson)."""
+    return {
+        "logistic_regression": AUC,
+        "linear_regression": RMSE,
+        "poisson_regression": POISSON_LOSS,
+        "smoothed_hinge_loss_linear_svm": AUC,
+    }[task_type]
+
+
+def parse_evaluator(spec: str):
+    """Parse "AUC", "RMSE", "PRECISION@K:10:groupCol", "AUC:groupCol".
+
+    reference: EvaluatorType / MultiEvaluatorType string parsing
+    (MultiEvaluatorType.scala:60, e.g. PRECISION@K:10:queryId)."""
+    parts = spec.split(":")
+    head = parts[0].upper()
+    if head == "PRECISION@K":
+        if len(parts) != 3:
+            raise ValueError(f"PRECISION@K needs k and group column: {spec!r}")
+        k = int(parts[1])
+        return MultiEvaluator(f"PRECISION@{k}:{parts[2]}",
+                              lambda s, y, w, _k=k: precision_at_k(_k, s, y, w),
+                              larger_is_better=True), parts[2]
+    if len(parts) == 2:
+        base = _BY_NAME[head]
+        return MultiEvaluator(f"{base.name}:{parts[1]}", base.fn,
+                              base.larger_is_better), parts[1]
+    if head in _BY_NAME:
+        return _BY_NAME[head], None
+    raise ValueError(f"unknown evaluator {spec!r}; known: {sorted(_BY_NAME)}")

@@ -1,0 +1,183 @@
+"""Typed GAME training configuration with JSON round-trip.
+
+Rebuild of the reference's three-tier string config system (SURVEY §5.6):
+  - GLMOptimizationConfiguration mini-DSL strings
+    (photon-api/.../optimization/game/GLMOptimizationConfiguration.scala:29-126)
+  - Fixed/RandomEffectDataConfiguration comma-field strings
+    (photon-api/.../data/*DataConfiguration.scala)
+  - GameTrainingParams CLI surface (photon-client/.../cli/game/training/
+    GameTrainingParams.scala:47-615)
+
+One typed dataclass tree replaces all three; `to_dict`/`from_dict` give the
+JSON round-trip the reference embeds in model metadata for scoring-side
+reproducibility (ModelProcessingUtils.scala:517-559).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Union
+
+from photon_ml_tpu.data.batching import RandomEffectDataConfig
+from photon_ml_tpu.ops.normalization import NormalizationType
+from photon_ml_tpu.optim import (
+    OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationConfig:
+    """(optimizer, regularization, weight, down-sampling) — reference:
+    GLMOptimizationConfiguration."""
+
+    optimizer: OptimizerConfig = OptimizerConfig()
+    regularization: RegularizationContext = RegularizationContext()
+    regularization_weight: float = 0.0
+    downsampling_rate: Optional[float] = None
+
+    def __post_init__(self):
+        if self.regularization_weight < 0:
+            raise ValueError("regularization_weight must be >= 0")
+        if self.downsampling_rate is not None and not 0 < self.downsampling_rate < 1:
+            raise ValueError("downsampling_rate must be in (0, 1)")
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinateConfig:
+    """reference: FixedEffectDataConfiguration + its optimization config."""
+
+    feature_shard: str
+    optimization: GLMOptimizationConfig = GLMOptimizationConfig()
+    normalization: NormalizationType = NormalizationType.NONE
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinateConfig:
+    """reference: RandomEffectDataConfiguration + its optimization config."""
+
+    random_effect_type: str
+    feature_shard: str
+    optimization: GLMOptimizationConfig = GLMOptimizationConfig()
+    active_data_upper_bound: Optional[int] = None
+    passive_data_lower_bound: Optional[int] = None
+    features_to_samples_ratio: Optional[float] = None
+    projector: str = "index_map"
+
+    def data_config(self, seed: int = 7) -> RandomEffectDataConfig:
+        return RandomEffectDataConfig(
+            random_effect_type=self.random_effect_type,
+            feature_shard=self.feature_shard,
+            active_data_upper_bound=self.active_data_upper_bound,
+            passive_data_lower_bound=self.passive_data_lower_bound,
+            features_to_samples_ratio=self.features_to_samples_ratio,
+            projector=self.projector,
+            seed=seed)
+
+
+CoordinateConfig = Union[FixedEffectCoordinateConfig, RandomEffectCoordinateConfig]
+
+
+@dataclasses.dataclass(frozen=True)
+class GameTrainingConfig:
+    """reference: GameTrainingParams (task, per-coordinate configs, updating
+    sequence, outer iterations)."""
+
+    task_type: str
+    coordinates: Dict[str, CoordinateConfig]
+    updating_sequence: Sequence[str]
+    num_outer_iterations: int = 1
+    seed: int = 7
+
+    def __post_init__(self):
+        missing = [c for c in self.updating_sequence if c not in self.coordinates]
+        if missing:
+            raise ValueError(f"updating_sequence names unknown coordinates: {missing}")
+        if self.num_outer_iterations < 1:
+            raise ValueError("num_outer_iterations must be >= 1")
+
+    # -- JSON round-trip ------------------------------------------------------
+    def to_dict(self) -> dict:
+        def enc_opt(o: OptimizerConfig):
+            return {"optimizer": o.optimizer.value, "max_iterations": o.max_iterations,
+                    "tolerance": o.tolerance, "history": o.history,
+                    "max_cg_iterations": o.max_cg_iterations,
+                    "box_lower": list(o.box_lower) if o.box_lower else None,
+                    "box_upper": list(o.box_upper) if o.box_upper else None}
+
+        def enc_glm(g: GLMOptimizationConfig):
+            return {"optimizer": enc_opt(g.optimizer),
+                    "regularization": {"type": g.regularization.reg_type.value,
+                                       "alpha": g.regularization.elastic_net_alpha},
+                    "regularization_weight": g.regularization_weight,
+                    "downsampling_rate": g.downsampling_rate}
+
+        coords = {}
+        for name, c in self.coordinates.items():
+            if isinstance(c, FixedEffectCoordinateConfig):
+                coords[name] = {"kind": "fixed_effect",
+                                "feature_shard": c.feature_shard,
+                                "normalization": c.normalization.value,
+                                "optimization": enc_glm(c.optimization)}
+            else:
+                coords[name] = {"kind": "random_effect",
+                                "random_effect_type": c.random_effect_type,
+                                "feature_shard": c.feature_shard,
+                                "active_data_upper_bound": c.active_data_upper_bound,
+                                "passive_data_lower_bound": c.passive_data_lower_bound,
+                                "features_to_samples_ratio": c.features_to_samples_ratio,
+                                "projector": c.projector,
+                                "optimization": enc_glm(c.optimization)}
+        return {"task_type": self.task_type, "coordinates": coords,
+                "updating_sequence": list(self.updating_sequence),
+                "num_outer_iterations": self.num_outer_iterations,
+                "seed": self.seed}
+
+    @staticmethod
+    def from_dict(d: dict) -> "GameTrainingConfig":
+        def dec_opt(o: dict) -> OptimizerConfig:
+            return OptimizerConfig(
+                optimizer=OptimizerType(o["optimizer"]),
+                max_iterations=o.get("max_iterations"),
+                tolerance=o.get("tolerance"),
+                history=o.get("history", 10),
+                max_cg_iterations=o.get("max_cg_iterations", 20),
+                box_lower=tuple(o["box_lower"]) if o.get("box_lower") else None,
+                box_upper=tuple(o["box_upper"]) if o.get("box_upper") else None)
+
+        def dec_glm(g: dict) -> GLMOptimizationConfig:
+            return GLMOptimizationConfig(
+                optimizer=dec_opt(g["optimizer"]),
+                regularization=RegularizationContext(
+                    RegularizationType(g["regularization"]["type"]),
+                    g["regularization"].get("alpha")),
+                regularization_weight=g["regularization_weight"],
+                downsampling_rate=g.get("downsampling_rate"))
+
+        coords: Dict[str, CoordinateConfig] = {}
+        for name, c in d["coordinates"].items():
+            if c["kind"] == "fixed_effect":
+                coords[name] = FixedEffectCoordinateConfig(
+                    feature_shard=c["feature_shard"],
+                    optimization=dec_glm(c["optimization"]),
+                    normalization=NormalizationType(c.get("normalization", "none")))
+            else:
+                coords[name] = RandomEffectCoordinateConfig(
+                    random_effect_type=c["random_effect_type"],
+                    feature_shard=c["feature_shard"],
+                    optimization=dec_glm(c["optimization"]),
+                    active_data_upper_bound=c.get("active_data_upper_bound"),
+                    passive_data_lower_bound=c.get("passive_data_lower_bound"),
+                    features_to_samples_ratio=c.get("features_to_samples_ratio"),
+                    projector=c.get("projector", "index_map"))
+        return GameTrainingConfig(
+            task_type=d["task_type"], coordinates=coords,
+            updating_sequence=d["updating_sequence"],
+            num_outer_iterations=d.get("num_outer_iterations", 1),
+            seed=d.get("seed", 7))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "GameTrainingConfig":
+        return GameTrainingConfig.from_dict(json.loads(s))

@@ -1,0 +1,192 @@
+"""Training coordinates: the per-block-update unit of GAME.
+
+Rebuild of the reference's Coordinate tower:
+  - Coordinate (photon-lib/.../algorithm/Coordinate.scala:27-80):
+    updateModel(model, partial scores) = re-offset own dataset with the other
+    coordinates' scores, then optimize
+  - FixedEffectCoordinate (photon-api/.../algorithm/FixedEffectCoordinate.scala:34-167)
+  - RandomEffectCoordinate (photon-api/.../algorithm/RandomEffectCoordinate.scala:39-222)
+  - RandomEffectCoordinateInProjectedSpace (.../RandomEffectCoordinateInProjectedSpace.scala)
+    — projection is folded into the dataset build here (data/batching.py)
+
+A coordinate owns its (device-resident) training data and knows how to
+(re)fit its model given the current residual offsets; scores are returned in
+the dataset's canonical row order so CoordinateDescent can combine them with
+plain array arithmetic.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batching import (
+    FixedEffectDataConfig, FixedEffectDataset, RandomEffectDataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.samplers import downsampler_for_task
+from photon_ml_tpu.data.stats import BasicStatisticalSummary
+from photon_ml_tpu.game.config import (
+    FixedEffectCoordinateConfig, RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.glm import model_for_task
+from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+from photon_ml_tpu.ops.normalization import (
+    NormalizationContext, NormalizationType, build_normalization_context,
+)
+from photon_ml_tpu.optim import SolveResult, solve
+from photon_ml_tpu.parallel.fixed_effect import _cached_solver, fit_fixed_effect
+from photon_ml_tpu.parallel.random_effect import (
+    fit_random_effects, score_by_entity,
+)
+
+
+class FixedEffectCoordinate:
+    """Global GLM over one feature shard (reference:
+    FixedEffectCoordinate.scala).  Normalization is trained-in /
+    mapped-out per update; down-sampling draws a fresh mask per update
+    (reference: DistributedOptimizationProblem.runWithSampling:143)."""
+
+    def __init__(self, name: str, dataset: GameDataset,
+                 config: FixedEffectCoordinateConfig, task_type: str,
+                 mesh=None, seed: int = 7):
+        self.name = name
+        self.config = config
+        self.task_type = task_type
+        self.loss = TASK_LOSSES[task_type]
+        self.mesh = mesh
+        self.x = jnp.asarray(dataset.feature_shards[config.feature_shard])
+        self.labels = jnp.asarray(dataset.response)
+        self.weights = (None if dataset.weights is None
+                        else jnp.asarray(dataset.weights))
+        self.dim = self.x.shape[1]
+        self._key = jax.random.PRNGKey(seed)
+
+        self.norm: Optional[NormalizationContext] = None
+        if config.normalization != NormalizationType.NONE:
+            imap = dataset.index_maps.get(config.feature_shard)
+            intercept = (imap.intercept_index if imap is not None
+                         else self.dim - 1)  # intercept-last convention
+            summ = BasicStatisticalSummary.from_features(
+                np.asarray(self.x), None if self.weights is None
+                else np.asarray(self.weights))
+            self.norm = build_normalization_context(
+                config.normalization,
+                mean=jnp.asarray(summ.mean), variance=jnp.asarray(summ.variance),
+                max_magnitude=jnp.asarray(summ.max_magnitude),
+                intercept_index=intercept)
+
+    def initial_model(self) -> FixedEffectModel:
+        """reference: Coordinate.initializeModel — zero coefficients."""
+        return FixedEffectModel(
+            model_for_task(self.task_type, Coefficients.zeros(self.dim, self.x.dtype)),
+            self.config.feature_shard)
+
+    def update(self, model: FixedEffectModel, offsets: jax.Array
+               ) -> Tuple[FixedEffectModel, SolveResult]:
+        """Refit with residual offsets (partial scores + base offsets).
+        reference: FixedEffectCoordinate.updateModel -> runWithSampling."""
+        opt = self.config.optimization
+        weights = self.weights
+        if opt.downsampling_rate is not None:
+            self._key, sub = jax.random.split(self._key)
+            keep, weights = downsampler_for_task(self.task_type)(
+                sub, self.labels, self.weights, opt.downsampling_rate)
+            weights = weights * keep
+        obj = GLMObjective(self.loss, self.x, self.labels, weights=weights,
+                           offsets=offsets, norm=self.norm)
+        x0 = model.glm.coefficients.means
+        if self.norm is not None:
+            x0 = self.norm.model_to_transformed_space(x0)
+        if self.mesh is not None:
+            res = fit_fixed_effect(obj, x0, self.mesh, opt.optimizer,
+                                   opt.regularization, opt.regularization_weight)
+        else:
+            res = _cached_solver(opt.optimizer, opt.regularization)(
+                obj, x0, jnp.asarray(opt.regularization_weight, self.x.dtype))
+        c = res.x
+        if self.norm is not None:
+            c = self.norm.model_to_original_space(c)
+        new_model = FixedEffectModel(
+            model_for_task(self.task_type, Coefficients(c)),
+            self.config.feature_shard)
+        return new_model, res
+
+    def score(self, model: FixedEffectModel) -> jax.Array:
+        """Margin contribution on the TRAINING data, canonical order."""
+        return self.x @ model.glm.coefficients.means
+
+    def regularization_term(self, model: FixedEffectModel) -> float:
+        """reference: Coordinate.computeRegularizationTermValue."""
+        opt = self.config.optimization
+        l1, l2 = opt.regularization.split(opt.regularization_weight)
+        c = model.glm.coefficients.means
+        return float(0.5 * l2 * jnp.dot(c, c) + l1 * jnp.sum(jnp.abs(c)))
+
+
+class RandomEffectCoordinate:
+    """Per-entity GLMs over one feature shard (reference:
+    RandomEffectCoordinate.scala + the projected-space wrapper)."""
+
+    def __init__(self, name: str, dataset: GameDataset,
+                 config: RandomEffectCoordinateConfig, task_type: str,
+                 mesh=None, seed: int = 7):
+        self.name = name
+        self.config = config
+        self.task_type = task_type
+        self.loss = TASK_LOSSES[task_type]
+        self.mesh = mesh
+        self.red: RandomEffectDataset = build_random_effect_dataset(
+            dataset, config.data_config(seed))
+        self.flat_x = jnp.asarray(dataset.feature_shards[config.feature_shard])
+        self.lanes = jnp.asarray(self.red.flat_entity_lanes(
+            dataset.entity_indices[config.random_effect_type]))
+        self.entity_id_values = np.asarray(
+            dataset.entity_vocabs[config.random_effect_type])[self.red.entity_ids]
+
+    def initial_model(self) -> RandomEffectModel:
+        E, dl = self.red.num_entities, self.red.local_dim
+        return RandomEffectModel(
+            random_effect_type=self.config.random_effect_type,
+            feature_shard=self.config.feature_shard,
+            task_type=self.task_type,
+            coefficients=jnp.zeros((E, dl), self.red.blocks.x.dtype),
+            entity_ids=self.entity_id_values,
+            projection=self.red.projection,
+            global_dim=self.red.global_dim)
+
+    def update(self, model: RandomEffectModel, offsets: jax.Array
+               ) -> Tuple[RandomEffectModel, SolveResult]:
+        """reference: RandomEffectCoordinate.updateModel — the 3-way join +
+        per-entity local solves become one gather + one batched solve."""
+        opt = self.config.optimization
+        blocks = self.red.with_offsets_from_flat(offsets)
+        res = fit_random_effects(
+            blocks, self.loss, self.mesh, x0=model.coefficients,
+            config=opt.optimizer, reg=opt.regularization,
+            reg_weight=opt.regularization_weight)
+        new_model = dataclasses.replace(model, coefficients=res.x)
+        return new_model, res
+
+    def score(self, model: RandomEffectModel) -> jax.Array:
+        """All rows (active AND passive) scored against their entity's model
+        via static gather — the reference's separate passive-data broadcast
+        path (RandomEffectCoordinate.scala:178-210) collapses into this."""
+        return score_by_entity(model.global_coefficients(), self.flat_x, self.lanes)
+
+    def regularization_term(self, model: RandomEffectModel) -> float:
+        """Sum over entities (reference: RandomEffectOptimizationProblem
+        .getRegularizationTermValue — join + map + reduce, here one einsum)."""
+        opt = self.config.optimization
+        l1, l2 = opt.regularization.split(opt.regularization_weight)
+        c = model.coefficients
+        return float(0.5 * l2 * jnp.sum(c * c) + l1 * jnp.sum(jnp.abs(c)))
+
+
+Coordinate = FixedEffectCoordinate | RandomEffectCoordinate

@@ -1,0 +1,134 @@
+"""GameEstimator: dataset + config -> trained GAME model(s).
+
+reference: GameEstimator (photon-api/.../estimators/GameEstimator.scala:52):
+fit() converts the input data, builds per-coordinate datasets/problems,
+prepares loss/validation evaluators, and runs CoordinateDescent once per
+optimization configuration (grid), returning (model, evaluations, config)
+triples; `fit_grid` here mirrors that multi-config sweep
+(GameEstimator.scala:474 train per config).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.evaluation.evaluators import (
+    default_validation_evaluator_for_task, parse_evaluator,
+)
+from photon_ml_tpu.game.config import (
+    CoordinateConfig, FixedEffectCoordinateConfig, GameTrainingConfig,
+    GLMOptimizationConfig, RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.game.coordinate_descent import (
+    CoordinateDescentResult, ValidationSpec, run_coordinate_descent,
+)
+from photon_ml_tpu.game.coordinates import (
+    Coordinate, FixedEffectCoordinate, RandomEffectCoordinate,
+)
+from photon_ml_tpu.models.game import GameModel
+
+
+@dataclasses.dataclass
+class GameResult:
+    """One trained configuration (reference: GameEstimator.GameResult)."""
+
+    model: GameModel
+    config: GameTrainingConfig
+    objective_history: List[float]
+    validation: Dict[str, float]          # final value per evaluator
+    descent: CoordinateDescentResult
+    validation_specs: List[ValidationSpec] = dataclasses.field(default_factory=list)
+
+
+class GameEstimator:
+    def __init__(self, config: GameTrainingConfig, mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    def _build_coordinates(self, dataset: GameDataset) -> Dict[str, Coordinate]:
+        coords: Dict[str, Coordinate] = {}
+        for name in self.config.updating_sequence:
+            cfg = self.config.coordinates[name]
+            if isinstance(cfg, FixedEffectCoordinateConfig):
+                coords[name] = FixedEffectCoordinate(
+                    name, dataset, cfg, self.config.task_type, self.mesh,
+                    seed=self.config.seed)
+            else:
+                coords[name] = RandomEffectCoordinate(
+                    name, dataset, cfg, self.config.task_type, self.mesh,
+                    seed=self.config.seed)
+        return coords
+
+    def _validation_specs(self, evaluator_specs: Optional[Sequence[str]]
+                          ) -> List[ValidationSpec]:
+        if not evaluator_specs:
+            ev = default_validation_evaluator_for_task(self.config.task_type)
+            return [ValidationSpec(ev)]
+        out = []
+        for spec in evaluator_specs:
+            ev, group = parse_evaluator(spec)
+            out.append(ValidationSpec(ev, group))
+        return out
+
+    def fit(
+        self,
+        dataset: GameDataset,
+        validation_dataset: Optional[GameDataset] = None,
+        evaluator_specs: Optional[Sequence[str]] = None,
+    ) -> GameResult:
+        """reference: GameEstimator.fit (GameEstimator.scala:175)."""
+        coords = self._build_coordinates(dataset)
+        specs = (self._validation_specs(evaluator_specs)
+                 if validation_dataset is not None else [])
+        descent = run_coordinate_descent(
+            coords, self.config.updating_sequence,
+            self.config.num_outer_iterations, dataset, self.config.task_type,
+            validation_dataset=validation_dataset, validation_specs=specs)
+        validation = {name: hist[-1] for name, hist in
+                      descent.validation_history.items() if hist}
+        return GameResult(model=descent.best_model, config=self.config,
+                          objective_history=descent.objective_history,
+                          validation=validation, descent=descent,
+                          validation_specs=specs)
+
+    def fit_grid(
+        self,
+        dataset: GameDataset,
+        grid: Dict[str, Sequence[GLMOptimizationConfig]],
+        validation_dataset: Optional[GameDataset] = None,
+        evaluator_specs: Optional[Sequence[str]] = None,
+    ) -> List[GameResult]:
+        """Sweep per-coordinate optimization configs (cartesian product),
+        reference: GameTrainingParams.getAllModelConfigs + train-per-config
+        (GameEstimator.scala:474)."""
+        names = list(grid)
+        results = []
+        for combo in itertools.product(*(grid[n] for n in names)):
+            coords = dict(self.config.coordinates)
+            for n, opt in zip(names, combo):
+                coords[n] = dataclasses.replace(coords[n], optimization=opt)
+            cfg = dataclasses.replace(self.config, coordinates=coords)
+            results.append(GameEstimator(cfg, self.mesh).fit(
+                dataset, validation_dataset, evaluator_specs))
+        return results
+
+
+def select_best_result(results: Sequence[GameResult]) -> GameResult:
+    """Best by the first validation evaluator, using that evaluator's own
+    metric direction (reference: cli/game/training/Driver.selectBestModel:375)."""
+    if not results:
+        raise ValueError("no results")
+    with_val = [r for r in results if r.validation and r.validation_specs]
+    if not with_val:
+        return results[0]
+    spec = with_val[0].validation_specs[0]
+    best = with_val[0]
+    for r in with_val[1:]:
+        if spec.evaluator.better_than(r.validation[spec.name],
+                                      best.validation[spec.name]):
+            best = r
+    return best

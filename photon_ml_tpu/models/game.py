@@ -1,0 +1,148 @@
+"""GAME model containers: fixed-effect, random-effect, and the composite.
+
+reference:
+  - DatumScoringModel (photon-lib/.../model/DatumScoringModel.scala:32-52)
+  - GameModel (photon-lib/.../model/GameModel.scala:32-168): coordinate map,
+    total score = sum of sub-scores, consistent task check
+  - FixedEffectModel (photon-api/.../model/FixedEffectModel.scala:31)
+  - RandomEffectModel (photon-api/.../model/RandomEffectModel.scala:38-290)
+  - RandomEffectModelInProjectedSpace (.../RandomEffectModelInProjectedSpace.scala)
+
+Scoring semantics follow the reference: a model's score is ITS margin
+contribution only (no base offset — evaluators add score+offset,
+Evaluator.scala:35-45), and rows whose entity is unknown to a random-effect
+model contribute 0 (the reference's missing-score default).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.ops import losses as L
+from photon_ml_tpu.parallel.random_effect import score_by_entity
+
+
+@dataclasses.dataclass
+class FixedEffectModel:
+    """One global GLM bound to a feature shard (reference:
+    FixedEffectModel.scala — the Broadcast wrapper is obsolete: coefficients
+    are just a device array, replicated by sharding when distributed)."""
+
+    glm: GeneralizedLinearModel
+    feature_shard: str
+
+    @property
+    def task_type(self) -> str:
+        return type(self.glm).task_type
+
+    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+        x = jnp.asarray(dataset.feature_shards[self.feature_shard])
+        return self.glm.compute_score(x)
+
+    def summary(self) -> str:
+        c = self.glm.coefficients.means
+        return (f"FixedEffectModel(shard={self.feature_shard}, dim={c.shape[-1]}, "
+                f"|w|={float(jnp.linalg.norm(c)):.4g})")
+
+
+@dataclasses.dataclass
+class RandomEffectModel:
+    """Per-entity coefficients in a (possibly projected) local space.
+
+    Like the reference's RandomEffectModelInProjectedSpace, the model stores
+    compact local-space coefficients plus the projection back to the global
+    shard space; entity identity is carried as raw id strings so the model
+    scores datasets with different vocabularies (reference keys the model
+    RDD by REId for the same reason)."""
+
+    random_effect_type: str
+    feature_shard: str
+    task_type: str
+    coefficients: jax.Array               # [E, d_local]
+    entity_ids: np.ndarray                # [E] raw entity id values
+    projection: Optional[np.ndarray]      # [E, d_local] global cols, -1 pad
+    global_dim: int
+    variances: Optional[jax.Array] = None  # [E, d_local]
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_ids)
+
+    def global_coefficients(self) -> jax.Array:
+        """[E, d_global] via scatter (reference:
+        IndexMapProjectorRDD.projectCoefficientsRDD)."""
+        from photon_ml_tpu.parallel.random_effect import scatter_local_to_global
+        return scatter_local_to_global(self.coefficients, self.projection,
+                                       self.global_dim)
+
+    def lanes_for(self, dataset: GameDataset) -> np.ndarray:
+        """Map the dataset's entity-index column to this model's lanes by raw
+        id — the static-gather replacement for the reference's
+        data-keyBy(REId) ⋈ model join (RandomEffectModel.scala:256)."""
+        vocab = dataset.entity_vocabs[self.random_effect_type]
+        lookup = {v: i for i, v in enumerate(self.entity_ids.tolist())}
+        vocab_to_lane = np.asarray([lookup.get(v, -1) for v in vocab.tolist()],
+                                   dtype=np.int64)
+        idx = dataset.entity_indices[self.random_effect_type]
+        lanes = np.where(idx >= 0, vocab_to_lane[np.maximum(idx, 0)], -1)
+        return lanes
+
+    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+        x = jnp.asarray(dataset.feature_shards[self.feature_shard])
+        lanes = jnp.asarray(self.lanes_for(dataset))
+        return score_by_entity(self.global_coefficients(), x, lanes)
+
+    def summary(self) -> str:
+        return (f"RandomEffectModel(type={self.random_effect_type}, "
+                f"shard={self.feature_shard}, entities={self.num_entities}, "
+                f"local_dim={self.coefficients.shape[-1]})")
+
+
+CoordinateModel = FixedEffectModel | RandomEffectModel
+
+
+@dataclasses.dataclass
+class GameModel:
+    """Ordered coordinate -> model map; total score is the sum.
+
+    reference: GameModel.scala:32-168 incl. the consistent-task check
+    (line 163)."""
+
+    coordinates: Dict[str, CoordinateModel]
+    task_type: str
+
+    def __post_init__(self):
+        for name, m in self.coordinates.items():
+            if m.task_type != self.task_type:
+                raise ValueError(
+                    f"coordinate {name!r} has task {m.task_type!r}, "
+                    f"expected {self.task_type!r} (reference: GameModel task "
+                    "consistency check)")
+
+    @property
+    def loss(self) -> L.PointwiseLoss:
+        return L.TASK_LOSSES[self.task_type]
+
+    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+        """Sum of coordinate margins (reference: GameModel.scala:101-112)."""
+        total = jnp.zeros(dataset.num_rows)
+        for m in self.coordinates.values():
+            total = total + m.score_dataset(dataset)
+        return total
+
+    def predict(self, dataset: GameDataset) -> jax.Array:
+        z = self.score_dataset(dataset)
+        if dataset.offsets is not None:
+            z = z + jnp.asarray(dataset.offsets)
+        return self.loss.mean(z)
+
+    def summary(self) -> str:
+        lines = [f"GameModel(task={self.task_type})"]
+        lines += [f"  {name}: {m.summary()}" for name, m in self.coordinates.items()]
+        return "\n".join(lines)

@@ -116,9 +116,17 @@ def score_fixed_effect(model: GeneralizedLinearModel, x, mesh: Mesh,
     """Sharded margin computation (reference: FixedEffectModel scoring via
     broadcast dot product, FixedEffectCoordinate.scala:143-152).  Scores come
     back sharded over "data" — they stay device-resident for coordinate
-    descent's residual exchange."""
+    descent's residual exchange.  Rows are padded to a mesh multiple and the
+    padding sliced off the result."""
+    n = x.shape[0]
+    rem = (-n) % mesh.shape[DATA_AXIS]
+    if rem:
+        x = jnp.concatenate([x, jnp.zeros((rem,) + x.shape[1:], x.dtype)])
+        if offsets is not None:
+            offsets = jnp.concatenate([offsets, jnp.zeros((rem,), offsets.dtype)])
     x = jax.device_put(x, data_sharding(mesh, x.ndim))
     if offsets is not None:
         offsets = jax.device_put(offsets, data_sharding(mesh, offsets.ndim))
     with mesh:
-        return _cached_scorer()(model.coefficients.means, x, offsets)
+        scores = _cached_scorer()(model.coefficients.means, x, offsets)
+    return scores[:n] if rem else scores

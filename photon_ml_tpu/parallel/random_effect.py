@@ -153,6 +153,22 @@ def fit_random_effects(
     return res
 
 
+def scatter_local_to_global(coefficients: jax.Array, projection,
+                            global_dim: int) -> jax.Array:
+    """[E, d_local] local-space coefficients -> [E, d_global] by scattering
+    along each entity's projection columns (-1 = padding).  Shared by
+    RandomEffectDataset and RandomEffectModel (reference:
+    IndexMapProjector.projectCoefficients)."""
+    if projection is None:
+        return coefficients
+    E, dl = coefficients.shape
+    proj = jnp.asarray(projection)
+    rows = jnp.repeat(jnp.arange(E), dl)
+    cols = jnp.maximum(proj, 0).reshape(-1)
+    vals = jnp.where(proj >= 0, coefficients, 0.0).reshape(-1)
+    return jnp.zeros((E, global_dim), coefficients.dtype).at[rows, cols].add(vals)
+
+
 def score_entity_blocks(coefficients: jax.Array, blocks: EntityBlocks) -> jax.Array:
     """Margins for every (entity, sample) cell: [E, S] = einsum over d.
     Masked cells score 0.  reference: RandomEffectModel scoring of active

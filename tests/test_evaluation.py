@@ -1,0 +1,83 @@
+"""Evaluator correctness vs sklearn-free closed forms and brute force.
+
+Mirrors reference: AreaUnderROCCurveEvaluatorTest / LocalEvaluator tests /
+MultiEvaluator grouping tests.
+"""
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import (
+    AUC, RMSE, MultiEvaluator, area_under_roc_curve,
+    default_validation_evaluator_for_task, parse_evaluator, precision_at_k,
+)
+
+
+def _brute_auc(s, y, w=None):
+    s, y = np.asarray(s, float), np.asarray(y, float)
+    w = np.ones_like(s) if w is None else np.asarray(w, float)
+    num = den = 0.0
+    for i in np.nonzero(y > 0.5)[0]:
+        for j in np.nonzero(y <= 0.5)[0]:
+            ww = w[i] * w[j]
+            den += ww
+            if s[i] > s[j]:
+                num += ww
+            elif s[i] == s[j]:
+                num += 0.5 * ww
+    return num / den
+
+
+def test_auc_matches_bruteforce(rng):
+    for trial in range(5):
+        n = 60
+        s = rng.normal(size=n).round(1)  # rounding forces ties
+        y = (rng.uniform(size=n) > 0.4).astype(float)
+        w = rng.uniform(0.5, 2.0, size=n)
+        np.testing.assert_allclose(area_under_roc_curve(s, y, w),
+                                   _brute_auc(s, y, w), rtol=1e-12)
+        np.testing.assert_allclose(area_under_roc_curve(s, y),
+                                   _brute_auc(s, y), rtol=1e-12)
+
+
+def test_auc_perfect_and_random():
+    y = np.asarray([0, 0, 1, 1], float)
+    assert area_under_roc_curve([1, 2, 3, 4], y) == 1.0
+    assert area_under_roc_curve([4, 3, 2, 1], y) == 0.0
+    assert area_under_roc_curve([1, 1, 1, 1], y) == 0.5
+    assert np.isnan(area_under_roc_curve([1, 2], [1, 1]))  # one class
+
+
+def test_rmse_and_direction():
+    assert RMSE([1, 2], [1, 2]) == 0.0
+    np.testing.assert_allclose(RMSE([0, 0], [3, 4]), np.sqrt(12.5))
+    assert RMSE.better_than(0.5, 1.0) and not RMSE.better_than(1.0, 0.5)
+    assert AUC.better_than(0.9, 0.6) and not AUC.better_than(0.6, 0.9)
+    assert AUC.better_than(0.6, float("nan")) and not AUC.better_than(float("nan"), 0.6)
+
+
+def test_precision_at_k():
+    s = [0.9, 0.8, 0.7, 0.1]
+    y = [1, 0, 1, 1]
+    assert precision_at_k(2, s, y) == 0.5
+    assert precision_at_k(3, s, y) == pytest.approx(2 / 3)
+
+
+def test_multi_evaluator_grouping(rng):
+    # two groups with known AUCs 1.0 and 0.5 -> mean 0.75; group -1 ignored
+    g = np.asarray([0, 0, 0, 0, 1, 1, 1, 1, -1])
+    s = np.asarray([.1, .2, .3, .4, .5, .5, .5, .5, 9.0])
+    y = np.asarray([0, 0, 1, 1, 0, 1, 0, 1, 1.0])
+    me = MultiEvaluator("AUC:g", area_under_roc_curve, larger_is_better=True)
+    np.testing.assert_allclose(me.evaluate_grouped(g, s, y), 0.75)
+
+
+def test_parse_evaluator():
+    e, col = parse_evaluator("AUC")
+    assert e.name == "AUC" and col is None
+    e, col = parse_evaluator("PRECISION@K:5:queryId")
+    assert col == "queryId" and e.larger_is_better
+    e, col = parse_evaluator("RMSE:userId")
+    assert isinstance(e, MultiEvaluator) and col == "userId"
+    with pytest.raises(ValueError):
+        parse_evaluator("NOPE")
+    assert default_validation_evaluator_for_task("logistic_regression").name == "AUC"
