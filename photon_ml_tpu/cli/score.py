@@ -1,0 +1,63 @@
+"""Scoring CLI.
+
+reference: GAME scoring driver (photon-client/.../cli/game/scoring/
+Driver.scala:37-309): load model + data -> score -> save scores + optional
+evaluation.
+
+  python -m photon_ml_tpu.cli.score --model-dir out/best \
+      --data test.npz --output scores.npz [--evaluators AUC,RMSE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-ml-tpu-score")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--data", required=True, help=".npz GameDataset or .libsvm")
+    p.add_argument("--output", required=True, help="scores .npz output path")
+    p.add_argument("--evaluators", default=None)
+    p.add_argument("--predict", action="store_true",
+                   help="also emit mean predictions (inverse link)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from photon_ml_tpu.cli.train import _load_dataset
+    from photon_ml_tpu.evaluation import parse_evaluator
+    from photon_ml_tpu.models.io import load_game_model
+
+    model, _config = load_game_model(args.model_dir)
+    ds = _load_dataset(args.data, model.task_type)
+    scores = np.asarray(model.score_dataset(ds))
+    out = {"scores": scores}
+    if args.predict:
+        out["predictions"] = np.asarray(model.predict(ds))
+    np.savez_compressed(args.output if args.output.endswith(".npz")
+                        else args.output + ".npz", **out)
+
+    result = {"rows": int(ds.num_rows), "output": args.output,
+              "evaluation": {}}
+    if args.evaluators:
+        total = scores + (ds.offsets if ds.offsets is not None else 0.0)
+        for spec in args.evaluators.split(","):
+            ev, group = parse_evaluator(spec)
+            if group is not None:
+                v = ev.evaluate_grouped(ds.entity_indices[group], total,
+                                        ds.response, ds.weights)
+            else:
+                v = ev(total, ds.response, ds.weights)
+            result["evaluation"][ev.name] = v
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

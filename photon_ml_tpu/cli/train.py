@@ -1,0 +1,146 @@
+"""Training CLI: the single driver replacing both reference drivers.
+
+reference: the legacy stage-machine Driver (photon-client/.../Driver.scala:71-739)
+and the GAME training driver (photon-client/.../cli/game/training/Driver.scala:50-505)
+are folded into one subcommand (SURVEY §7 "What NOT to port"):
+
+  python -m photon_ml_tpu.cli.train \
+      --train-data data.npz|data.libsvm --task logistic_regression \
+      --output-dir out/ [--validation-data v.npz] [--config game.json]
+      [--reg-weights 0.1,1,10] [--evaluators AUC,PRECISION@K:10:userId] ...
+
+Without --config, a single fixed-effect coordinate over the "global" shard
+is trained (the legacy single-GLM pipeline: preprocess -> train lambda sweep
+-> validate -> select best); with --config (GameTrainingConfig JSON), the
+full GAME coordinate-descent path runs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu-train",
+        description="Train GLM / GAME mixed-effect models on TPU (JAX)")
+    p.add_argument("--train-data", required=True,
+                   help=".npz GameDataset or .libsvm file")
+    p.add_argument("--validation-data", default=None)
+    p.add_argument("--task", default="logistic_regression",
+                   choices=["logistic_regression", "linear_regression",
+                            "poisson_regression", "smoothed_hinge_loss_linear_svm"])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--config", default=None,
+                   help="GameTrainingConfig JSON file (enables GAME path)")
+    p.add_argument("--optimizer", default="lbfgs", choices=["lbfgs", "tron"])
+    p.add_argument("--regularization", default="l2",
+                   choices=["none", "l1", "l2", "elastic_net"])
+    p.add_argument("--elastic-net-alpha", type=float, default=None)
+    p.add_argument("--reg-weights", default="1.0",
+                   help="comma-separated lambda sweep (legacy path)")
+    p.add_argument("--max-iterations", type=int, default=None)
+    p.add_argument("--tolerance", type=float, default=None)
+    p.add_argument("--normalization", default="none",
+                   choices=["none", "scale_with_standard_deviation",
+                            "scale_with_max_magnitude", "standardization"])
+    p.add_argument("--evaluators", default=None,
+                   help="comma-separated, e.g. AUC,RMSE,PRECISION@K:10:userId")
+    p.add_argument("--compute-variances", action="store_true")
+    p.add_argument("--x64", action="store_true", help="float64 (parity runs)")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def _load_dataset(path: str, task: str):
+    from photon_ml_tpu.data import build_game_dataset, read_libsvm
+    from photon_ml_tpu.data.game_data import load_game_dataset
+    if path.endswith(".libsvm") or path.endswith(".txt"):
+        x, y = read_libsvm(path)
+        return build_game_dataset(y, {"global": x})
+    return load_game_dataset(path)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(message)s", stream=sys.stderr)
+
+    import jax
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+
+    from photon_ml_tpu.data.stats import BasicStatisticalSummary
+    from photon_ml_tpu.game import GameEstimator, GameTrainingConfig
+    from photon_ml_tpu.game.config import (FixedEffectCoordinateConfig,
+                                           GLMOptimizationConfig)
+    from photon_ml_tpu.models.io import save_game_model
+    from photon_ml_tpu.ops.normalization import NormalizationType
+    from photon_ml_tpu.optim import (OptimizerConfig, OptimizerType,
+                                     RegularizationContext, RegularizationType)
+
+    t0 = time.time()
+    train = _load_dataset(args.train_data, args.task)
+    val = (_load_dataset(args.validation_data, args.task)
+           if args.validation_data else None)
+    print(f"loaded train: {train.num_rows} rows, shards "
+          f"{ {s: x.shape[1] for s, x in train.feature_shards.items()} }",
+          file=sys.stderr)
+
+    if args.config:
+        with open(args.config) as f:
+            config = GameTrainingConfig.from_json(f.read())
+        results = [GameEstimator(config).fit(
+            train, val, args.evaluators.split(",") if args.evaluators else None)]
+    else:
+        # legacy single-GLM path: one FE coordinate, lambda sweep, best by
+        # first validation evaluator (reference: Driver stage machine +
+        # ModelSelection)
+        reg = RegularizationContext(RegularizationType(args.regularization),
+                                    args.elastic_net_alpha)
+        opt = OptimizerConfig(optimizer=OptimizerType(args.optimizer),
+                              max_iterations=args.max_iterations,
+                              tolerance=args.tolerance)
+        weights = [float(w) for w in args.reg_weights.split(",")]
+        grid = {"fixed": [GLMOptimizationConfig(optimizer=opt, regularization=reg,
+                                                regularization_weight=w)
+                          for w in sorted(weights, reverse=True)]}
+        config = GameTrainingConfig(
+            task_type=args.task,
+            coordinates={"fixed": FixedEffectCoordinateConfig(
+                "global", GLMOptimizationConfig(optimizer=opt, regularization=reg),
+                normalization=NormalizationType(args.normalization))},
+            updating_sequence=["fixed"])
+        results = GameEstimator(config).fit_grid(
+            train, grid, val,
+            args.evaluators.split(",") if args.evaluators else None)
+
+    from photon_ml_tpu.game.estimator import select_best_result
+    best = select_best_result(results)
+    os.makedirs(args.output_dir, exist_ok=True)
+    save_game_model(best.model, os.path.join(args.output_dir, "best"),
+                    config=best.config, index_maps=train.index_maps or None)
+    summary = {
+        "task": args.task,
+        "train_rows": train.num_rows,
+        "num_configs": len(results),
+        "final_objective": best.objective_history[-1],
+        "validation": best.validation,
+        "wall_s": round(time.time() - t0, 2),
+        "output": os.path.join(args.output_dir, "best"),
+    }
+    with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

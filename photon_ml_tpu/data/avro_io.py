@@ -1,0 +1,268 @@
+"""Reference-compatible Avro I/O: training data, models, scores.
+
+Schema-compatible with the reference's photon-avro-schemas
+(photon-avro-schemas/src/main/avro/*.avsc) so data and models interchange
+with the Spark implementation:
+  - TrainingExampleAvro + FeatureAvro  (read path of AvroDataReader,
+    photon-client/.../data/avro/AvroDataReader.scala:53-451)
+  - BayesianLinearModelAvro + NameTermValueAvro  (model save/load of
+    ModelProcessingUtils.scala:58-669)
+  - ScoringResultAvro  (ScoreProcessingUtils.scala)
+  - LatentFactorAvro   (matrix factorization save/load)
+
+The reference reads feature bags per shard and merges them
+(AvroDataReader.readMerged); here one bag per file is read into a dense
+[n, d] shard via an IndexMap (sparse BCOO assembly is a dataset-build
+option at the call site).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.avro_codec import read_container, write_container
+from photon_ml_tpu.data.index_map import (
+    INTERCEPT_KEY, IndexMap, build_index_map, feature_key,
+)
+
+_NS = "com.linkedin.photon.avro.generated"
+
+FEATURE_AVRO = {"name": "FeatureAvro", "namespace": _NS, "type": "record",
+                "fields": [{"name": "name", "type": "string"},
+                           {"name": "term", "type": "string"},
+                           {"name": "value", "type": "double"}]}
+
+TRAINING_EXAMPLE_AVRO = {
+    "name": "TrainingExampleAvro", "namespace": _NS, "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": FEATURE_AVRO}},
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+    ]}
+
+NAME_TERM_VALUE_AVRO = {"name": "NameTermValueAvro", "namespace": _NS,
+                        "type": "record",
+                        "fields": [{"name": "name", "type": "string"},
+                                   {"name": "term", "type": "string"},
+                                   {"name": "value", "type": "double"}]}
+
+BAYESIAN_LINEAR_MODEL_AVRO = {
+    "name": "BayesianLinearModelAvro", "namespace": _NS, "type": "record",
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": ["null", "string"], "default": None},
+        {"name": "means", "type": {"type": "array", "items": NAME_TERM_VALUE_AVRO}},
+        {"name": "variances",
+         "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+         "default": None},
+        {"name": "lossFunction", "type": ["null", "string"], "default": None},
+    ]}
+
+SCORING_RESULT_AVRO = {
+    "name": "ScoringResultAvro", "namespace": _NS, "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {"name": "modelId", "type": "string"},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}],
+         "default": None},
+    ]}
+
+LATENT_FACTOR_AVRO = {
+    "name": "LatentFactorAvro", "namespace": _NS, "type": "record",
+    "fields": [{"name": "effectId", "type": "string"},
+               {"name": "latentFactor",
+                "type": {"type": "array", "items": "double"}}]}
+
+
+# -- training data -----------------------------------------------------------
+
+
+def write_training_examples(
+    path: str,
+    x: np.ndarray,
+    y: np.ndarray,
+    index_map: IndexMap,
+    weights: Optional[np.ndarray] = None,
+    offsets: Optional[np.ndarray] = None,
+    uids: Optional[List[str]] = None,
+    metadata: Optional[List[Dict[str, str]]] = None,
+) -> None:
+    """Dense [n, d] (intercept column skipped) -> TrainingExampleAvro file."""
+    intercept = index_map.intercept_index
+
+    def gen():
+        for i in range(x.shape[0]):
+            feats = []
+            row = x[i]
+            for j in np.nonzero(row)[0]:
+                if intercept is not None and j == intercept:
+                    continue
+                name, term = index_map.name_term(int(j))
+                feats.append({"name": name, "term": term, "value": float(row[j])})
+            yield {"uid": uids[i] if uids else None,
+                   "label": float(y[i]), "features": feats,
+                   "metadataMap": metadata[i] if metadata else None,
+                   "weight": None if weights is None else float(weights[i]),
+                   "offset": None if offsets is None else float(offsets[i])}
+
+    write_container(path, TRAINING_EXAMPLE_AVRO, gen())
+
+
+def read_training_examples(
+    paths: str | Iterable[str],
+    index_map: Optional[IndexMap] = None,
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray],
+           List[Optional[str]], IndexMap]:
+    """TrainingExampleAvro file(s) -> (x, y, weights, offsets, uids, index_map).
+
+    Two-pass like the reference FeatureIndexingJob + AvroDataReader: build
+    the (name, term) index map first (unless given), then fill the dense
+    matrix with the intercept column appended last."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    paths = list(paths)
+    if index_map is None:
+        names = []
+        for p in paths:
+            for rec in read_container(p):
+                names.extend((f["name"], f["term"]) for f in rec["features"])
+        index_map = build_index_map(names, add_intercept=True)
+
+    rows = []
+    for p in paths:
+        rows.extend(read_container(p))
+    n, d = len(rows), index_map.size
+    x = np.zeros((n, d))
+    y = np.zeros(n)
+    weights = np.ones(n)
+    offsets = np.zeros(n)
+    any_w = any_o = False
+    uids: List[Optional[str]] = []
+    intercept = index_map.intercept_index
+    for i, rec in enumerate(rows):
+        y[i] = rec["label"]
+        uids.append(rec.get("uid"))
+        if rec.get("weight") is not None:
+            weights[i] = rec["weight"]; any_w = True
+        if rec.get("offset") is not None:
+            offsets[i] = rec["offset"]; any_o = True
+        for f in rec["features"]:
+            j = index_map.index_of(f["name"], f["term"])
+            if j >= 0:
+                x[i, j] = f["value"]
+        if intercept is not None:
+            x[i, intercept] = 1.0
+    return (x, y, weights if any_w else None, offsets if any_o else None,
+            uids, index_map)
+
+
+# -- models ------------------------------------------------------------------
+
+_MODEL_CLASS = {
+    "logistic_regression":
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    "linear_regression":
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    "poisson_regression":
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    "smoothed_hinge_loss_linear_svm":
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_TASK_BY_CLASS = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+def write_glm_avro(path: str, model_id: str, task_type: str,
+                   means: np.ndarray, index_map: IndexMap,
+                   variances: Optional[np.ndarray] = None) -> None:
+    """One GLM -> BayesianLinearModelAvro record (reference:
+    ModelProcessingUtils + AvroUtils.convertGLMModelToBayesianLinearModelAvro)."""
+    def ntv(vec):
+        out = []
+        for j in np.nonzero(np.asarray(vec))[0]:
+            name, term = index_map.name_term(int(j))
+            out.append({"name": name, "term": term, "value": float(vec[j])})
+        return out
+
+    rec = {"modelId": model_id, "modelClass": _MODEL_CLASS.get(task_type),
+           "means": ntv(means),
+           "variances": None if variances is None else ntv(variances),
+           "lossFunction": None}
+    write_container(path, BAYESIAN_LINEAR_MODEL_AVRO, [rec])
+
+
+def read_glm_avro(path: str, index_map: Optional[IndexMap] = None
+                  ) -> Tuple[str, Optional[str], np.ndarray,
+                             Optional[np.ndarray], IndexMap]:
+    """-> (model_id, task_type, means, variances, index_map)."""
+    recs = list(read_container(path))
+    if len(recs) != 1:
+        raise ValueError(f"{path}: expected 1 model record, got {len(recs)}")
+    rec = recs[0]
+    if index_map is None:
+        keys = [(f["name"], f["term"]) for f in rec["means"]]
+        index_map = build_index_map(keys, add_intercept=True)
+    means = np.zeros(index_map.size)
+    for f in rec["means"]:
+        j = index_map.index_of(f["name"], f["term"])
+        if j >= 0:
+            means[j] = f["value"]
+    variances = None
+    if rec.get("variances"):
+        variances = np.zeros(index_map.size)
+        for f in rec["variances"]:
+            j = index_map.index_of(f["name"], f["term"])
+            if j >= 0:
+                variances[j] = f["value"]
+    task = _TASK_BY_CLASS.get(rec.get("modelClass") or "", None)
+    return rec["modelId"], task, means, variances, index_map
+
+
+# -- scores ------------------------------------------------------------------
+
+
+def write_scores_avro(path: str, model_id: str, scores: np.ndarray,
+                      labels: Optional[np.ndarray] = None,
+                      weights: Optional[np.ndarray] = None,
+                      uids: Optional[List[Optional[str]]] = None) -> None:
+    """reference: ScoreProcessingUtils.saveScoredItemsToHDFS."""
+    def gen():
+        for i, s in enumerate(np.asarray(scores)):
+            yield {"uid": uids[i] if uids else None,
+                   "label": None if labels is None else float(labels[i]),
+                   "modelId": model_id, "predictionScore": float(s),
+                   "weight": None if weights is None else float(weights[i]),
+                   "metadataMap": None}
+    write_container(path, SCORING_RESULT_AVRO, gen())
+
+
+def read_scores_avro(path: str):
+    recs = list(read_container(path))
+    scores = np.asarray([r["predictionScore"] for r in recs])
+    labels = np.asarray([r["label"] if r["label"] is not None else np.nan
+                         for r in recs])
+    return scores, labels, recs
+
+
+# -- latent factors (matrix factorization) -----------------------------------
+
+
+def write_latent_factors_avro(path: str, ids: Iterable[str],
+                              factors: np.ndarray) -> None:
+    write_container(path, LATENT_FACTOR_AVRO,
+                    ({"effectId": str(i), "latentFactor": list(map(float, f))}
+                     for i, f in zip(ids, np.asarray(factors))))
+
+
+def read_latent_factors_avro(path: str) -> Tuple[List[str], np.ndarray]:
+    recs = list(read_container(path))
+    return ([r["effectId"] for r in recs],
+            np.asarray([r["latentFactor"] for r in recs]))

@@ -85,6 +85,42 @@ class GameDataset:
         )
 
 
+def save_game_dataset(dataset: GameDataset, path: str) -> None:
+    """Columnar npz persistence of a GameDataset (role of the reference's
+    Avro input files once converted; see data/avro_io.py for Avro itself)."""
+    arrays = {"response": dataset.response}
+    if dataset.offsets is not None:
+        arrays["offsets"] = dataset.offsets
+    if dataset.weights is not None:
+        arrays["weights"] = dataset.weights
+    for s, x in dataset.feature_shards.items():
+        arrays[f"shard::{s}"] = x
+    for t, idx in dataset.entity_indices.items():
+        arrays[f"entidx::{t}"] = idx
+        arrays[f"entvocab::{t}"] = np.asarray(dataset.entity_vocabs[t]).astype(object)
+    np.savez_compressed(path if path.endswith(".npz") else path + ".npz", **arrays)
+
+
+def load_game_dataset(path: str) -> GameDataset:
+    z = np.load(path if path.endswith(".npz") else path + ".npz",
+                allow_pickle=True)
+    shards, entidx, entvocab = {}, {}, {}
+    for k in z.files:
+        if k.startswith("shard::"):
+            shards[k[7:]] = z[k]
+        elif k.startswith("entidx::"):
+            entidx[k[8:]] = z[k]
+        elif k.startswith("entvocab::"):
+            entvocab[k[10:]] = z[k]
+    return GameDataset(
+        response=z["response"],
+        feature_shards=shards,
+        offsets=z["offsets"] if "offsets" in z.files else None,
+        weights=z["weights"] if "weights" in z.files else None,
+        entity_indices=entidx,
+        entity_vocabs=entvocab)
+
+
 def build_game_dataset(
     response: np.ndarray,
     feature_shards: Dict[str, np.ndarray],

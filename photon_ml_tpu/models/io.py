@@ -1,0 +1,137 @@
+"""Model persistence: the checkpoint format.
+
+Rebuild of ModelProcessingUtils (photon-client/.../data/avro/
+ModelProcessingUtils.scala:58-669): GAME models persist to a directory tree
+
+    <dir>/model-metadata.json                     # task, config, coordinates
+    <dir>/fixed-effect/<name>/coefficients.npz    # means (+variances)
+    <dir>/random-effect/<name>/coefficients.npz   # [E, d_local] + projection
+                                                  # + entity ids + global dim
+
+mirroring the reference's fixed-effect/<coord>/coefficients/part-*.avro and
+random-effect/<coord>/... layout with npz in place of Avro records (an Avro
+export for cross-tool parity lives in photon_ml_tpu/data/avro_io.py).
+model-metadata.json embeds the full training config JSON exactly like the
+reference embeds optimizer configs for scoring-side reproducibility
+(ModelProcessingUtils.scala:517-559).  Feature names are stored when an
+IndexMap is provided, matching the reference's human-readable name.term
+output.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.game.config import GameTrainingConfig
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import model_for_task
+
+_FORMAT_VERSION = 1
+
+
+def save_game_model(
+    model: GameModel,
+    directory: str,
+    config: Optional[GameTrainingConfig] = None,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+) -> None:
+    """reference: ModelProcessingUtils.saveGameModelsToHDFS (scala:71-135)."""
+    os.makedirs(directory, exist_ok=True)
+    meta = {"format_version": _FORMAT_VERSION, "task_type": model.task_type,
+            "coordinates": {}, "config": config.to_dict() if config else None}
+    for name, m in model.coordinates.items():
+        if isinstance(m, FixedEffectModel):
+            sub = os.path.join(directory, "fixed-effect", name)
+            os.makedirs(sub, exist_ok=True)
+            arrays = {"means": np.asarray(m.glm.coefficients.means)}
+            if m.glm.coefficients.variances is not None:
+                arrays["variances"] = np.asarray(m.glm.coefficients.variances)
+            imap = (index_maps or {}).get(m.feature_shard)
+            if imap is not None:
+                arrays["feature_keys"] = imap.index_to_key.astype(object)
+            np.savez_compressed(os.path.join(sub, "coefficients.npz"), **arrays)
+            meta["coordinates"][name] = {"kind": "fixed_effect",
+                                         "feature_shard": m.feature_shard}
+        elif isinstance(m, RandomEffectModel):
+            sub = os.path.join(directory, "random-effect", name)
+            os.makedirs(sub, exist_ok=True)
+            arrays = {"coefficients": np.asarray(m.coefficients),
+                      "entity_ids": np.asarray(m.entity_ids).astype(object),
+                      "global_dim": np.asarray(m.global_dim)}
+            if m.projection is not None:
+                arrays["projection"] = m.projection
+            if m.variances is not None:
+                arrays["variances"] = np.asarray(m.variances)
+            np.savez_compressed(os.path.join(sub, "coefficients.npz"), **arrays)
+            meta["coordinates"][name] = {
+                "kind": "random_effect",
+                "random_effect_type": m.random_effect_type,
+                "feature_shard": m.feature_shard}
+        else:
+            raise TypeError(f"unknown coordinate model type {type(m)}")
+    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_game_model(directory: str
+                    ) -> Tuple[GameModel, Optional[GameTrainingConfig]]:
+    """reference: ModelProcessingUtils.loadGameModelFromHDFS (scala:136-238)."""
+    with open(os.path.join(directory, "model-metadata.json")) as f:
+        meta = json.load(f)
+    task = meta["task_type"]
+    coords = {}
+    for name, info in meta["coordinates"].items():
+        if info["kind"] == "fixed_effect":
+            z = np.load(os.path.join(directory, "fixed-effect", name,
+                                     "coefficients.npz"), allow_pickle=True)
+            coeffs = Coefficients(
+                jnp.asarray(z["means"]),
+                jnp.asarray(z["variances"]) if "variances" in z else None)
+            coords[name] = FixedEffectModel(model_for_task(task, coeffs),
+                                            info["feature_shard"])
+        else:
+            z = np.load(os.path.join(directory, "random-effect", name,
+                                     "coefficients.npz"), allow_pickle=True)
+            coords[name] = RandomEffectModel(
+                random_effect_type=info["random_effect_type"],
+                feature_shard=info["feature_shard"],
+                task_type=task,
+                coefficients=jnp.asarray(z["coefficients"]),
+                entity_ids=z["entity_ids"],
+                projection=z["projection"] if "projection" in z else None,
+                global_dim=int(z["global_dim"]),
+                variances=jnp.asarray(z["variances"]) if "variances" in z else None)
+    config = (GameTrainingConfig.from_dict(meta["config"])
+              if meta.get("config") else None)
+    return GameModel(coords, task), config
+
+
+def save_glm(model, directory: str, index_map: Optional[IndexMap] = None,
+             extra_metadata: Optional[dict] = None) -> None:
+    """Single-GLM save (reference: legacy GLMSuite.writeModelsToHDFS path)."""
+    os.makedirs(directory, exist_ok=True)
+    arrays = {"means": np.asarray(model.coefficients.means)}
+    if model.coefficients.variances is not None:
+        arrays["variances"] = np.asarray(model.coefficients.variances)
+    if index_map is not None:
+        arrays["feature_keys"] = index_map.index_to_key.astype(object)
+    np.savez_compressed(os.path.join(directory, "coefficients.npz"), **arrays)
+    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
+        json.dump({"format_version": _FORMAT_VERSION,
+                   "task_type": type(model).task_type,
+                   **(extra_metadata or {})}, f, indent=2)
+
+
+def load_glm(directory: str):
+    with open(os.path.join(directory, "model-metadata.json")) as f:
+        meta = json.load(f)
+    z = np.load(os.path.join(directory, "coefficients.npz"), allow_pickle=True)
+    coeffs = Coefficients(jnp.asarray(z["means"]),
+                          jnp.asarray(z["variances"]) if "variances" in z else None)
+    return model_for_task(meta["task_type"], coeffs), meta

@@ -1,0 +1,115 @@
+"""Avro codec + reference-schema I/O round-trips.
+
+Mirrors reference: AvroUtils / ModelProcessingUtils / GLMSuite round-trip
+tests.  Also validates the container format self-consistently (magic, sync,
+deflate) and the union/array/map encoding against tricky values.
+"""
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.avro_codec import read_container, write_container
+from photon_ml_tpu.data.avro_io import (
+    TRAINING_EXAMPLE_AVRO, read_glm_avro, read_scores_avro,
+    read_training_examples, read_latent_factors_avro, write_glm_avro,
+    write_latent_factors_avro, write_scores_avro, write_training_examples,
+)
+from photon_ml_tpu.data.index_map import IndexMap, build_index_map
+
+
+def test_container_roundtrip_tricky_values(tmp_path):
+    schema = {"name": "T", "type": "record", "fields": [
+        {"name": "l", "type": "long"},
+        {"name": "s", "type": "string"},
+        {"name": "o", "type": ["null", "double"], "default": None},
+        {"name": "m", "type": {"type": "map", "values": "long"}},
+        {"name": "a", "type": {"type": "array", "items": "string"}},
+        {"name": "b", "type": "boolean"},
+    ]}
+    recs = [
+        {"l": 0, "s": "", "o": None, "m": {}, "a": [], "b": False},
+        {"l": -1, "s": "héllo ☃", "o": -0.0, "m": {"k": 2**40}, "a": ["x", ""], "b": True},
+        {"l": 2**62, "s": "y", "o": 1e300, "m": {"a": -5, "b": 7}, "a": ["z"] * 5, "b": False},
+        {"l": -(2**62), "s": "n", "o": float("inf"), "m": {}, "a": [], "b": True},
+    ]
+    p = str(tmp_path / "t.avro")
+    for codec in ("null", "deflate"):
+        write_container(p, schema, recs, codec=codec)
+        back = list(read_container(p))
+        assert back == recs, codec
+
+
+def test_container_many_blocks(tmp_path):
+    schema = {"name": "R", "type": "record",
+              "fields": [{"name": "i", "type": "long"}]}
+    recs = [{"i": i} for i in range(10000)]
+    p = str(tmp_path / "many.avro")
+    write_container(p, schema, recs, block_records=512)
+    assert list(read_container(p)) == recs
+
+
+def test_corrupt_file_detected(tmp_path):
+    p = str(tmp_path / "bad.avro")
+    with open(p, "wb") as f:
+        f.write(b"NOTAVRO")
+    with pytest.raises(ValueError, match="not an Avro container"):
+        list(read_container(p))
+
+
+def test_training_examples_roundtrip(tmp_path, rng):
+    imap = build_index_map([("age", ""), ("height", "cm"), ("clicks", "7d")])
+    n, d = 40, imap.size
+    x = np.zeros((n, d))
+    x[:, :3] = rng.normal(size=(n, 3)) * (rng.uniform(size=(n, 3)) > 0.4)
+    x[:, imap.intercept_index] = 1.0
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    w = rng.uniform(0.5, 2, size=n)
+    o = rng.normal(size=n)
+    p = str(tmp_path / "train.avro")
+    write_training_examples(p, x, y, imap, weights=w, offsets=o,
+                            uids=[f"uid{i}" for i in range(n)])
+
+    x2, y2, w2, o2, uids, imap2 = read_training_examples(p, imap)
+    np.testing.assert_allclose(x2, x)
+    np.testing.assert_allclose(y2, y)
+    np.testing.assert_allclose(w2, w)
+    np.testing.assert_allclose(o2, o)
+    assert uids[0] == "uid0"
+
+    # auto-built index map path (reference FeatureIndexingJob role)
+    x3, y3, _, _, _, imap3 = read_training_examples(p)
+    assert imap3.size <= imap.size  # only observed features
+    np.testing.assert_allclose(y3, y)
+
+
+def test_glm_avro_roundtrip(tmp_path, rng):
+    imap = build_index_map([("f", str(i)) for i in range(6)])
+    means = rng.normal(size=imap.size)
+    means[2] = 0.0  # zero coefficients are dropped (sparse record)
+    var = rng.uniform(0.1, 1.0, size=imap.size)
+    p = str(tmp_path / "glm.avro")
+    write_glm_avro(p, "my-model", "logistic_regression", means, imap, var)
+    mid, task, means2, var2, _ = read_glm_avro(p, imap)
+    assert mid == "my-model" and task == "logistic_regression"
+    np.testing.assert_allclose(means2, means)
+    np.testing.assert_allclose(var2, var)
+
+
+def test_scores_avro_roundtrip(tmp_path, rng):
+    s = rng.normal(size=25)
+    y = (rng.uniform(size=25) > 0.5).astype(float)
+    p = str(tmp_path / "scores.avro")
+    write_scores_avro(p, "m1", s, labels=y)
+    s2, y2, recs = read_scores_avro(p)
+    np.testing.assert_allclose(s2, s)
+    np.testing.assert_allclose(y2, y)
+    assert recs[0]["modelId"] == "m1"
+
+
+def test_latent_factors_roundtrip(tmp_path, rng):
+    f = rng.normal(size=(8, 4))
+    ids = [f"item{i}" for i in range(8)]
+    p = str(tmp_path / "lf.avro")
+    write_latent_factors_avro(p, ids, f)
+    ids2, f2 = read_latent_factors_avro(p)
+    assert ids2 == ids
+    np.testing.assert_allclose(f2, f)

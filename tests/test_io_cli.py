@@ -1,0 +1,134 @@
+"""Model save->load->score round-trips + CLI end-to-end.
+
+Mirrors reference: ModelProcessingUtilsTest (save/load/compare GAME models)
+and the cli DriverTest e2e pattern (run the driver, assert outputs + metric
+thresholds).
+"""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import build_game_dataset, build_index_map
+from photon_ml_tpu.data.game_data import load_game_dataset, save_game_dataset
+from photon_ml_tpu.game import (
+    FixedEffectCoordinateConfig, GameEstimator, GameTrainingConfig,
+    GLMOptimizationConfig, RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.models.io import load_game_model, save_game_model
+from photon_ml_tpu.optim import RegularizationContext, RegularizationType
+from tests.test_game import _config, _dataset
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+def test_game_model_roundtrip(tmp_path, rng):
+    ds, _ = _dataset(rng, n=400)
+    res = GameEstimator(_config(iters=1)).fit(ds)
+    d = str(tmp_path / "model")
+    save_game_model(res.model, d, config=res.config)
+    loaded, cfg = load_game_model(d)
+    assert cfg == res.config
+    np.testing.assert_allclose(np.asarray(loaded.score_dataset(ds)),
+                               np.asarray(res.model.score_dataset(ds)),
+                               rtol=1e-12)
+    re = loaded.coordinates["perUser"]
+    assert re.num_entities == res.model.coordinates["perUser"].num_entities
+
+
+def test_dataset_npz_roundtrip(tmp_path, rng):
+    ds, _ = _dataset(rng, n=100)
+    p = str(tmp_path / "ds.npz")
+    save_game_dataset(ds, p)
+    back = load_game_dataset(p)
+    np.testing.assert_allclose(back.response, ds.response)
+    np.testing.assert_allclose(back.feature_shards["global"],
+                               ds.feature_shards["global"])
+    assert (back.entity_vocabs["userId"] == ds.entity_vocabs["userId"]).all()
+    assert (back.entity_indices["userId"] == ds.entity_indices["userId"]).all()
+
+
+@pytest.fixture
+def cli_env(tmp_path, rng):
+    """Train+val npz files on disk."""
+    ds, _ = _dataset(rng, n=800, task="logistic")
+    rows = np.arange(800)
+    train_p = str(tmp_path / "train.npz")
+    val_p = str(tmp_path / "val.npz")
+    save_game_dataset(ds.subset(rows[:600]), train_p)
+    save_game_dataset(ds.subset(rows[600:]), val_p)
+    return train_p, val_p, tmp_path
+
+
+def _run_cli(module, argv):
+    cmd = [sys.executable, "-m", module] + argv
+    env = {"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin:/usr/local/bin",
+           "JAX_PLATFORMS": "cpu", "HOME": "/root"}
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=420)
+
+
+def test_cli_train_and_score_legacy_path(cli_env):
+    train_p, val_p, tmp = cli_env
+    out_dir = str(tmp / "out")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", train_p, "--validation-data", val_p,
+                  "--task", "logistic_regression", "--output-dir", out_dir,
+                  "--reg-weights", "10,0.1", "--evaluators", "AUC,LOGISTIC_LOSS"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["num_configs"] == 2
+    assert summary["validation"]["AUC"] > 0.6
+
+    score_p = str(tmp / "scores.npz")
+    r2 = _run_cli("photon_ml_tpu.cli.score",
+                  ["--model-dir", summary["output"], "--data", val_p,
+                   "--output", score_p, "--evaluators", "AUC", "--predict"])
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    res = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert abs(res["evaluation"]["AUC"] - summary["validation"]["AUC"]) < 0.05
+    z = np.load(score_p)
+    assert z["scores"].shape == (200,)
+    assert ((z["predictions"] >= 0) & (z["predictions"] <= 1)).all()
+
+
+def test_cli_game_config_path(cli_env):
+    train_p, val_p, tmp = cli_env
+    cfg = GameTrainingConfig(
+        task_type="logistic_regression",
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                "global", GLMOptimizationConfig(regularization=L2,
+                                                regularization_weight=0.1)),
+            "perUser": RandomEffectCoordinateConfig(
+                "userId", "per_user",
+                GLMOptimizationConfig(regularization=L2,
+                                      regularization_weight=2.0)),
+        },
+        updating_sequence=["fixed", "perUser"], num_outer_iterations=2)
+    cfg_p = str(tmp / "game.json")
+    with open(cfg_p, "w") as f:
+        f.write(cfg.to_json())
+    out_dir = str(tmp / "out_game")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", train_p, "--validation-data", val_p,
+                  "--task", "logistic_regression", "--output-dir", out_dir,
+                  "--config", cfg_p, "--evaluators", "AUC,AUC:userId"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "AUC:userId" in summary["validation"]
+    # model dir exists with both coordinate kinds
+    loaded, cfg_back = load_game_model(summary["output"])
+    assert set(loaded.coordinates) == {"fixed", "perUser"}
+    assert cfg_back == cfg
+
+
+def test_cli_bad_args(cli_env):
+    train_p, _, tmp = cli_env
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", train_p, "--task", "not_a_task",
+                  "--output-dir", str(tmp / "x")])
+    assert r.returncode != 0
+    assert "invalid choice" in r.stderr
