@@ -111,6 +111,12 @@ class RandomEffectDataset:
     global_dim: int
     num_active: int
     num_passive: int
+    # canonical rows capped out of entities whose LEFTOVER count is at/below
+    # passive_data_lower_bound: DISCARDED, not scored (reference:
+    # RandomEffectDataSet.scala:399-446 keeps passive data only for entities
+    # whose passive count exceeds the bound) — flat_entity_lanes maps them to
+    # lane -1 so they contribute score 0, the missing-score default.
+    discarded_rows: Optional[np.ndarray] = None  # [k] canonical row ids
 
     @property
     def num_entities(self) -> int:
@@ -136,11 +142,14 @@ class RandomEffectDataset:
                                        self.projection, self.global_dim)
 
     def flat_entity_lanes(self, entity_index: np.ndarray) -> np.ndarray:
-        """Map a canonical-order entity-index column to block lanes."""
+        """Map a canonical-order entity-index column to block lanes.
+        Discarded rows (capped out of below-bound entities) get lane -1."""
         idx = np.asarray(entity_index)
         lanes = np.full_like(idx, -1)
         valid = idx >= 0
         lanes[valid] = self.entity_position[idx[valid]]
+        if self.discarded_rows is not None and len(self.discarded_rows):
+            lanes[self.discarded_rows] = -1
         return lanes
 
 
@@ -178,20 +187,29 @@ def build_random_effect_dataset(
     cap = config.active_data_upper_bound
     num_passive = 0
     active_rows_per_entity = []
+    discarded: list[np.ndarray] = []
     weight_scale = np.ones(E)
     for e in range(E):
         rows_e = rows_present[starts[e]: starts[e] + counts[e]]
         if cap is not None and len(rows_e) > cap:
             keep = rng.choice(len(rows_e), size=cap, replace=False)
             lower = config.passive_data_lower_bound
-            if lower is None or len(rows_e) > lower:
-                num_passive += len(rows_e) - cap
+            leftover_count = len(rows_e) - cap
+            if lower is None or leftover_count > lower:
+                num_passive += leftover_count
+            else:
+                # below-bound leftovers are discarded, not scored
+                # (reference: RandomEffectDataSet.scala:399-446)
+                leftover = np.setdiff1d(np.arange(len(rows_e)), keep)
+                discarded.append(rows_e[leftover])
             # weight rescale so the capped sample represents the full count
             # (reference: MinHeapWithFixedCapacity cumCount/size rescale,
             # RandomEffectDataSet.scala:325-388)
             weight_scale[e] = len(rows_e) / cap
             rows_e = rows_e[np.sort(keep)]
         active_rows_per_entity.append(rows_e)
+    discarded_rows = (np.concatenate(discarded) if discarded
+                      else np.zeros((0,), dtype=np.int64))
 
     S = max((len(r) for r in active_rows_per_entity), default=1)
     active_row_ids = np.full((E, S), -1, dtype=np.int64)
@@ -249,4 +267,5 @@ def build_random_effect_dataset(
         config=config, blocks=blocks, entity_ids=uniq,
         entity_position=entity_position, active_row_ids=active_row_ids,
         projection=projection, global_dim=d_global,
-        num_active=int(mask.sum()), num_passive=num_passive)
+        num_active=int(mask.sum()), num_passive=num_passive,
+        discarded_rows=discarded_rows)

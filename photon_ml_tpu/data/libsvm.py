@@ -36,12 +36,21 @@ def read_libsvm(
                     break
                 idx_s, _, val_s = tok.partition(":")
                 j = int(idx_s) - (0 if zero_based else 1)
+                if j < 0:
+                    raise ValueError(
+                        f"{path}: feature index {idx_s} on line {i + 1} is below "
+                        f"the {'0' if zero_based else '1'}-based minimum "
+                        "(pass zero_based=True for 0-based files)")
                 rows.append(len(labels) - 1)
                 cols.append(j)
                 vals.append(float(val_s))
                 max_col = max(max_col, j)
     n = len(labels)
     d = num_features if num_features is not None else max_col + 1
+    if max_col >= d:
+        raise ValueError(
+            f"{path}: feature index {max_col} out of range for "
+            f"num_features={d} (indices are {'0' if zero_based else '1'}-based)")
     x = np.zeros((n, d + (1 if add_intercept else 0)))
     x[np.asarray(rows, dtype=np.int64), np.asarray(cols, dtype=np.int64)] = vals
     if add_intercept:

@@ -66,9 +66,13 @@ def _loss_metric(loss: L.PointwiseLoss):
 
 
 def precision_at_k(k: int, scores, labels, weights=None) -> float:
+    """hits-in-top-k / k.  Weights are ignored and the denominator is k even
+    when the group has fewer than k rows, matching the reference exactly
+    (PrecisionAtKLocalEvaluator.scala: `1.0 * hits / k`, unweighted)."""
+    del weights
     s, y = _np(scores), _np(labels)
     top = np.argsort(-s, kind="stable")[:k]
-    return float((y[top] > 0.5).mean())
+    return float((y[top] > 0.5).sum() / k)
 
 
 @dataclasses.dataclass(frozen=True)

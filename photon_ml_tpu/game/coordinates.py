@@ -123,10 +123,15 @@ class FixedEffectCoordinate:
         return self.x @ model.glm.coefficients.means
 
     def regularization_term(self, model: FixedEffectModel) -> float:
-        """reference: Coordinate.computeRegularizationTermValue."""
+        """reference: Coordinate.computeRegularizationTermValue.  For a
+        normalized coordinate the solver penalized the NORMALIZED-space
+        coefficients, so the term is computed in that space — keeping the
+        logged objective consistent with the quantity actually minimized."""
         opt = self.config.optimization
         l1, l2 = opt.regularization.split(opt.regularization_weight)
         c = model.glm.coefficients.means
+        if self.norm is not None:
+            c = self.norm.model_to_transformed_space(c)
         return float(0.5 * l2 * jnp.dot(c, c) + l1 * jnp.sum(jnp.abs(c)))
 
 

@@ -72,9 +72,11 @@ def train_glm(
         return solve(objective, x0, optimizer_config, regularization, lam)
 
     @jax.jit
-    def _hessian_diag(c_original: jax.Array) -> jax.Array:
-        # variances in original space without normalization, as the reference
-        return objective.replace(norm=None).hessian_diagonal(c_original)
+    def _hessian_diag(c_original: jax.Array, l2_w: jax.Array) -> jax.Array:
+        # variances in original space without normalization, as the reference;
+        # the L2 part of the current lambda contributes to the Hessian diagonal
+        # (reference: L2Regularization.scala:164-165 adds l2RegWeight)
+        return objective.replace(norm=None).with_l2(l2_w).hessian_diagonal(c_original)
 
     if initial_model is not None:
         x0 = initial_model.coefficients.means.astype(dtype)
@@ -91,8 +93,12 @@ def train_glm(
         c_norm = res.x
         c_orig = (normalization.model_to_original_space(c_norm)
                   if normalization is not None else c_norm)
-        coeffs = (Coefficients.from_hessian_diagonal(c_orig, _hessian_diag(c_orig))
-                  if compute_variances else Coefficients(c_orig))
+        if compute_variances:
+            _, l2_w = regularization.split(jnp.asarray(lam, dtype))
+            coeffs = Coefficients.from_hessian_diagonal(
+                c_orig, _hessian_diag(c_orig, l2_w))
+        else:
+            coeffs = Coefficients(c_orig)
         out.append(TrainedModel(float(lam), model_for_task(task_type, coeffs), res))
         if warm_start:
             x0 = c_norm
