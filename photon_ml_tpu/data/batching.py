@@ -53,6 +53,9 @@ class RandomEffectDataConfig:
     active_data_upper_bound: Optional[int] = None
     passive_data_lower_bound: Optional[int] = None
     features_to_samples_ratio: Optional[float] = None
+    # "index_map" | "identity" | "random_projection:<k>"
+    # (reference: ProjectorType.scala — IndexMapProjection, IdentityProjection,
+    # RandomProjection(dim))
     projector: str = "index_map"
     seed: int = 7
 
@@ -111,6 +114,10 @@ class RandomEffectDataset:
     global_dim: int
     num_active: int
     num_passive: int
+    # dense Gaussian random-projection matrix [d_local, d_global], shared by
+    # all entities (reference: ProjectionMatrixBroadcast) — exclusive with
+    # the per-entity index `projection`
+    projection_matrix: Optional[np.ndarray] = None
     # canonical rows capped out of entities whose LEFTOVER count is at/below
     # passive_data_lower_bound: DISCARDED, not scored (reference:
     # RandomEffectDataSet.scala:399-446 keeps passive data only for entities
@@ -136,7 +143,10 @@ class RandomEffectDataset:
 
     def scatter_to_global(self, local_coefficients) -> jnp.ndarray:
         """[E, d_local] local-space coefficients -> [E, d_global]
-        (reference: IndexMapProjector.projectCoefficients)."""
+        (reference: IndexMapProjector.projectCoefficients /
+        ProjectionMatrix.projectCoefficients = P^T c)."""
+        if self.projection_matrix is not None:
+            return jnp.asarray(local_coefficients) @ jnp.asarray(self.projection_matrix)
         from photon_ml_tpu.parallel.random_effect import scatter_local_to_global
         return scatter_local_to_global(jnp.asarray(local_coefficients),
                                        self.projection, self.global_dim)
@@ -220,6 +230,7 @@ def build_random_effect_dataset(
 
     # per-entity feature projection (index-map projector): observed columns
     projection = None
+    proj_matrix = None
     if config.projector == "index_map":
         col_lists = []
         ratio = config.features_to_samples_ratio
@@ -250,9 +261,20 @@ def build_random_effect_dataset(
         x_blocks *= mask[:, :, None]
     elif config.projector == "identity":
         x_blocks = x_flat[safe_ids] * mask[:, :, None]
+    elif config.projector.startswith("random_projection:"):
+        # Gaussian random projection shared across entities (reference:
+        # ProjectionMatrixBroadcast.buildRandomProjectionBroadcastProjector +
+        # ProjectionMatrix.buildGaussianRandomProjectionMatrix, scala:95-125);
+        # the intercept column survives projection via the extra selector row
+        k = int(config.projector.split(":", 1)[1])
+        from photon_ml_tpu.parallel.factored import gaussian_projection_matrix
+        proj_matrix = np.asarray(gaussian_projection_matrix(
+            k, d_global, keep_intercept=True, seed=config.seed), dtype=dtype)
+        x_blocks = np.einsum("esd,kd->esk", x_flat[safe_ids] * mask[:, :, None],
+                             proj_matrix)
     else:
-        raise ValueError(f"unknown projector {config.projector!r} "
-                         "(expected 'index_map' or 'identity')")
+        raise ValueError(f"unknown projector {config.projector!r} (expected "
+                         "'index_map', 'identity', or 'random_projection:<k>')")
 
     labels = np.where(mask > 0, y_flat[safe_ids], _SAFE_LABEL)
     weights = (w_flat[safe_ids] if w_flat is not None else np.ones((E, S), dtype))
@@ -268,4 +290,4 @@ def build_random_effect_dataset(
         entity_position=entity_position, active_row_ids=active_row_ids,
         projection=projection, global_dim=d_global,
         num_active=int(mask.sum()), num_passive=num_passive,
-        discarded_rows=discarded_rows)
+        discarded_rows=discarded_rows, projection_matrix=proj_matrix)

@@ -1,11 +1,11 @@
 from photon_ml_tpu.game.config import (  # noqa: F401
-    FixedEffectCoordinateConfig, GameTrainingConfig, GLMOptimizationConfig,
-    RandomEffectCoordinateConfig,
+    FactoredRandomEffectCoordinateConfig, FixedEffectCoordinateConfig,
+    GameTrainingConfig, GLMOptimizationConfig, RandomEffectCoordinateConfig,
 )
 from photon_ml_tpu.game.coordinate_descent import (  # noqa: F401
     CoordinateDescentResult, ValidationSpec, run_coordinate_descent,
 )
 from photon_ml_tpu.game.coordinates import (  # noqa: F401
-    FixedEffectCoordinate, RandomEffectCoordinate,
+    FactoredRandomEffectCoordinate, FixedEffectCoordinate, RandomEffectCoordinate,
 )
 from photon_ml_tpu.game.estimator import GameEstimator, GameResult, select_best_result  # noqa: F401

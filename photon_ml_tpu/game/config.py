@@ -74,7 +74,46 @@ class RandomEffectCoordinateConfig:
             seed=seed)
 
 
-CoordinateConfig = Union[FixedEffectCoordinateConfig, RandomEffectCoordinateConfig]
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectCoordinateConfig:
+    """Matrix-factorized random effect: per-entity latent factors plus a
+    shared latent projection matrix, refit alternately.
+
+    reference: FactoredRandomEffectOptimizationConfiguration +
+    MFOptimizationConfiguration (photon-api/.../optimization/game/
+    MFOptimizationConfiguration.scala: numInnerIterations + latent dim),
+    with `optimization` for the per-entity (latent-space) problems and
+    `latent_optimization` for the projection-matrix problem."""
+
+    random_effect_type: str
+    feature_shard: str
+    latent_dim: int
+    num_inner_iterations: int = 1
+    optimization: GLMOptimizationConfig = GLMOptimizationConfig()
+    latent_optimization: GLMOptimizationConfig = GLMOptimizationConfig()
+    active_data_upper_bound: Optional[int] = None
+    passive_data_lower_bound: Optional[int] = None
+
+    def __post_init__(self):
+        if self.latent_dim < 1:
+            raise ValueError("latent_dim must be >= 1")
+        if self.num_inner_iterations < 1:
+            raise ValueError("num_inner_iterations must be >= 1")
+
+    def data_config(self, seed: int = 7) -> RandomEffectDataConfig:
+        # features stay in the original shard space ("identity"); the latent
+        # projection is part of the MODEL and is refit every update
+        return RandomEffectDataConfig(
+            random_effect_type=self.random_effect_type,
+            feature_shard=self.feature_shard,
+            active_data_upper_bound=self.active_data_upper_bound,
+            passive_data_lower_bound=self.passive_data_lower_bound,
+            projector="identity",
+            seed=seed)
+
+
+CoordinateConfig = Union[FixedEffectCoordinateConfig, RandomEffectCoordinateConfig,
+                         FactoredRandomEffectCoordinateConfig]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,6 +157,16 @@ class GameTrainingConfig:
                                 "feature_shard": c.feature_shard,
                                 "normalization": c.normalization.value,
                                 "optimization": enc_glm(c.optimization)}
+            elif isinstance(c, FactoredRandomEffectCoordinateConfig):
+                coords[name] = {"kind": "factored_random_effect",
+                                "random_effect_type": c.random_effect_type,
+                                "feature_shard": c.feature_shard,
+                                "latent_dim": c.latent_dim,
+                                "num_inner_iterations": c.num_inner_iterations,
+                                "active_data_upper_bound": c.active_data_upper_bound,
+                                "passive_data_lower_bound": c.passive_data_lower_bound,
+                                "optimization": enc_glm(c.optimization),
+                                "latent_optimization": enc_glm(c.latent_optimization)}
             else:
                 coords[name] = {"kind": "random_effect",
                                 "random_effect_type": c.random_effect_type,
@@ -160,6 +209,16 @@ class GameTrainingConfig:
                     feature_shard=c["feature_shard"],
                     optimization=dec_glm(c["optimization"]),
                     normalization=NormalizationType(c.get("normalization", "none")))
+            elif c["kind"] == "factored_random_effect":
+                coords[name] = FactoredRandomEffectCoordinateConfig(
+                    random_effect_type=c["random_effect_type"],
+                    feature_shard=c["feature_shard"],
+                    latent_dim=c["latent_dim"],
+                    num_inner_iterations=c.get("num_inner_iterations", 1),
+                    optimization=dec_glm(c["optimization"]),
+                    latent_optimization=dec_glm(c["latent_optimization"]),
+                    active_data_upper_bound=c.get("active_data_upper_bound"),
+                    passive_data_lower_bound=c.get("passive_data_lower_bound"))
             else:
                 coords[name] = RandomEffectCoordinateConfig(
                     random_effect_type=c["random_effect_type"],

@@ -31,10 +31,16 @@ from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.data.samplers import downsampler_for_task
 from photon_ml_tpu.data.stats import BasicStatisticalSummary
 from photon_ml_tpu.game.config import (
-    FixedEffectCoordinateConfig, RandomEffectCoordinateConfig,
+    FactoredRandomEffectCoordinateConfig, FixedEffectCoordinateConfig,
+    RandomEffectCoordinateConfig,
 )
 from photon_ml_tpu.models.coefficients import Coefficients
-from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.game import (
+    FactoredRandomEffectModel, FixedEffectModel, RandomEffectModel,
+)
+from photon_ml_tpu.parallel.factored import (
+    FactoredSolveResult, fit_factored_random_effects, gaussian_projection_matrix,
+)
 from photon_ml_tpu.models.glm import model_for_task
 from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
 from photon_ml_tpu.ops.normalization import (
@@ -135,12 +141,12 @@ class FixedEffectCoordinate:
         return float(0.5 * l2 * jnp.dot(c, c) + l1 * jnp.sum(jnp.abs(c)))
 
 
-class RandomEffectCoordinate:
-    """Per-entity GLMs over one feature shard (reference:
-    RandomEffectCoordinate.scala + the projected-space wrapper)."""
+class _EntityCoordinateBase:
+    """Shared setup for entity-keyed coordinates (plain and factored RE):
+    build the per-entity dataset, the flat feature view, and the
+    canonical-row -> entity-lane map used for scoring."""
 
-    def __init__(self, name: str, dataset: GameDataset,
-                 config: RandomEffectCoordinateConfig, task_type: str,
+    def __init__(self, name: str, dataset: GameDataset, config, task_type: str,
                  mesh=None, seed: int = 7):
         self.name = name
         self.config = config
@@ -155,6 +161,17 @@ class RandomEffectCoordinate:
         self.entity_id_values = np.asarray(
             dataset.entity_vocabs[config.random_effect_type])[self.red.entity_ids]
 
+    def _score_global(self, global_coefficients: jax.Array) -> jax.Array:
+        """All rows (active AND passive) scored against their entity's model
+        via static gather — the reference's separate passive-data broadcast
+        path (RandomEffectCoordinate.scala:178-210) collapses into this."""
+        return score_by_entity(global_coefficients, self.flat_x, self.lanes)
+
+
+class RandomEffectCoordinate(_EntityCoordinateBase):
+    """Per-entity GLMs over one feature shard (reference:
+    RandomEffectCoordinate.scala + the projected-space wrapper)."""
+
     def initial_model(self) -> RandomEffectModel:
         E, dl = self.red.num_entities, self.red.local_dim
         return RandomEffectModel(
@@ -164,7 +181,8 @@ class RandomEffectCoordinate:
             coefficients=jnp.zeros((E, dl), self.red.blocks.x.dtype),
             entity_ids=self.entity_id_values,
             projection=self.red.projection,
-            global_dim=self.red.global_dim)
+            global_dim=self.red.global_dim,
+            projection_matrix=self.red.projection_matrix)
 
     def update(self, model: RandomEffectModel, offsets: jax.Array
                ) -> Tuple[RandomEffectModel, SolveResult]:
@@ -180,10 +198,7 @@ class RandomEffectCoordinate:
         return new_model, res
 
     def score(self, model: RandomEffectModel) -> jax.Array:
-        """All rows (active AND passive) scored against their entity's model
-        via static gather — the reference's separate passive-data broadcast
-        path (RandomEffectCoordinate.scala:178-210) collapses into this."""
-        return score_by_entity(model.global_coefficients(), self.flat_x, self.lanes)
+        return self._score_global(model.global_coefficients())
 
     def regularization_term(self, model: RandomEffectModel) -> float:
         """Sum over entities (reference: RandomEffectOptimizationProblem
@@ -194,4 +209,88 @@ class RandomEffectCoordinate:
         return float(0.5 * l2 * jnp.sum(c * c) + l1 * jnp.sum(jnp.abs(c)))
 
 
-Coordinate = FixedEffectCoordinate | RandomEffectCoordinate
+class FactoredRandomEffectCoordinate(_EntityCoordinateBase):
+    """Matrix-factorized per-entity GLMs: latent factors per entity + a
+    shared projection matrix, refit alternately (reference:
+    FactoredRandomEffectCoordinate.scala:40-281)."""
+
+    def __init__(self, name: str, dataset: GameDataset,
+                 config: FactoredRandomEffectCoordinateConfig, task_type: str,
+                 mesh=None, seed: int = 7):
+        super().__init__(name, dataset, config, task_type, mesh, seed)
+        self.seed = seed
+        self._key = jax.random.PRNGKey(seed + 1)
+
+    def initial_model(self) -> FactoredRandomEffectModel:
+        """Zero latent factors + Gaussian random projection (reference:
+        FactoredRandomEffectCoordinate.initializeModel, with
+        isKeepingInterceptTerm=false)."""
+        E = self.red.num_entities
+        k = self.config.latent_dim
+        d = self.red.global_dim
+        dtype = self.red.blocks.x.dtype
+        return FactoredRandomEffectModel(
+            random_effect_type=self.config.random_effect_type,
+            feature_shard=self.config.feature_shard,
+            task_type=self.task_type,
+            latent_coefficients=jnp.zeros((E, k), dtype),
+            projection=gaussian_projection_matrix(k, d, keep_intercept=False,
+                                                  seed=self.seed, dtype=dtype),
+            entity_ids=self.entity_id_values,
+            global_dim=d)
+
+    def update(self, model: FactoredRandomEffectModel, offsets: jax.Array
+               ) -> Tuple[FactoredRandomEffectModel, FactoredSolveResult]:
+        opt = self.config.optimization
+        lat = self.config.latent_optimization
+        blocks = self.red.with_offsets_from_flat(offsets)
+
+        latent_row_weights_fn = None
+        if lat.downsampling_rate is not None:
+            E, S = blocks.labels.shape
+            flat_labels = blocks.labels.reshape(E * S)
+            sampler = downsampler_for_task(self.task_type)
+
+            def latent_row_weights_fn(it: int):
+                # fresh draw per inner iteration (reference: runWithSampling
+                # called inside each updateLatentProjectionMatrix)
+                self._key, sub = jax.random.split(self._key)
+                keep, w = sampler(sub, flat_labels, None, lat.downsampling_rate)
+                return keep * w
+
+        res = fit_factored_random_effects(
+            blocks, self.loss, self.mesh,
+            latent_coefficients=model.latent_coefficients,
+            projection=model.projection,
+            num_inner_iterations=self.config.num_inner_iterations,
+            re_config=opt.optimizer, re_reg=opt.regularization,
+            re_reg_weight=opt.regularization_weight,
+            latent_config=lat.optimizer, latent_reg=lat.regularization,
+            latent_reg_weight=lat.regularization_weight,
+            latent_row_weights_fn=latent_row_weights_fn)
+        new_model = dataclasses.replace(
+            model, latent_coefficients=res.latent_coefficients,
+            projection=res.projection)
+        return new_model, res
+
+    def score(self, model: FactoredRandomEffectModel) -> jax.Array:
+        """c_e . (P x) == (C @ P)[e] . x — one [E,k]x[k,d] matmul then the
+        same entity-gather scoring as a plain random effect."""
+        return self._score_global(model.global_coefficients())
+
+    def regularization_term(self, model: FactoredRandomEffectModel) -> float:
+        """RE term over latent factors + latent-problem term over P
+        (reference: FactoredRandomEffectOptimizationProblem
+        .getRegularizationTermValue)."""
+        opt, lat = self.config.optimization, self.config.latent_optimization
+        l1, l2 = opt.regularization.split(opt.regularization_weight)
+        c = model.latent_coefficients
+        term = 0.5 * l2 * jnp.sum(c * c) + l1 * jnp.sum(jnp.abs(c))
+        pl1, pl2 = lat.regularization.split(lat.regularization_weight)
+        p = model.projection
+        term = term + 0.5 * pl2 * jnp.sum(p * p) + pl1 * jnp.sum(jnp.abs(p))
+        return float(term)
+
+
+Coordinate = (FixedEffectCoordinate | RandomEffectCoordinate
+              | FactoredRandomEffectCoordinate)

@@ -20,14 +20,16 @@ from photon_ml_tpu.evaluation.evaluators import (
     default_validation_evaluator_for_task, parse_evaluator,
 )
 from photon_ml_tpu.game.config import (
-    CoordinateConfig, FixedEffectCoordinateConfig, GameTrainingConfig,
-    GLMOptimizationConfig, RandomEffectCoordinateConfig,
+    CoordinateConfig, FactoredRandomEffectCoordinateConfig,
+    FixedEffectCoordinateConfig, GameTrainingConfig, GLMOptimizationConfig,
+    RandomEffectCoordinateConfig,
 )
 from photon_ml_tpu.game.coordinate_descent import (
     CoordinateDescentResult, ValidationSpec, run_coordinate_descent,
 )
 from photon_ml_tpu.game.coordinates import (
-    Coordinate, FixedEffectCoordinate, RandomEffectCoordinate,
+    Coordinate, FactoredRandomEffectCoordinate, FixedEffectCoordinate,
+    RandomEffectCoordinate,
 )
 from photon_ml_tpu.models.game import GameModel
 
@@ -55,6 +57,10 @@ class GameEstimator:
             cfg = self.config.coordinates[name]
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 coords[name] = FixedEffectCoordinate(
+                    name, dataset, cfg, self.config.task_type, self.mesh,
+                    seed=self.config.seed)
+            elif isinstance(cfg, FactoredRandomEffectCoordinateConfig):
+                coords[name] = FactoredRandomEffectCoordinate(
                     name, dataset, cfg, self.config.task_type, self.mesh,
                     seed=self.config.seed)
             else:

@@ -5,3 +5,7 @@ from photon_ml_tpu.models.glm import (  # noqa: F401
     SmoothedHingeLossLinearSVMModel, model_for_task,
 )
 from photon_ml_tpu.models.training import TrainedModel, best_model_by_validation, train_glm  # noqa: F401
+from photon_ml_tpu.models.game import (  # noqa: F401
+    FactoredRandomEffectModel, FixedEffectModel, GameModel,
+    MatrixFactorizationModel, RandomEffectModel,
+)

@@ -69,6 +69,14 @@ class RandomEffectModel:
     projection: Optional[np.ndarray]      # [E, d_local] global cols, -1 pad
     global_dim: int
     variances: Optional[jax.Array] = None  # [E, d_local]
+    # dense shared random-projection matrix [d_local, d_global] (reference:
+    # ProjectionMatrixBroadcast) — exclusive with the index `projection`
+    projection_matrix: Optional[jax.Array] = None
+
+    def __post_init__(self):
+        # device-resident once: scoring runs every coordinate-descent update
+        if self.projection_matrix is not None:
+            self.projection_matrix = jnp.asarray(self.projection_matrix)
 
     @property
     def num_entities(self) -> int:
@@ -76,7 +84,10 @@ class RandomEffectModel:
 
     def global_coefficients(self) -> jax.Array:
         """[E, d_global] via scatter (reference:
-        IndexMapProjectorRDD.projectCoefficientsRDD)."""
+        IndexMapProjectorRDD.projectCoefficientsRDD) or dense P^T c
+        (reference: ProjectionMatrixBroadcast.projectCoefficientsRDD)."""
+        if self.projection_matrix is not None:
+            return self.coefficients @ self.projection_matrix
         from photon_ml_tpu.parallel.random_effect import scatter_local_to_global
         return scatter_local_to_global(self.coefficients, self.projection,
                                        self.global_dim)
@@ -104,7 +115,128 @@ class RandomEffectModel:
                 f"local_dim={self.coefficients.shape[-1]})")
 
 
-CoordinateModel = FixedEffectModel | RandomEffectModel
+@dataclasses.dataclass
+class FactoredRandomEffectModel:
+    """Per-entity latent factors [E, k] + a shared latent projection [k, d].
+
+    reference: FactoredRandomEffectModel (photon-api/.../model/
+    FactoredRandomEffectModel.scala:33) = modelsInProjectedSpace +
+    ProjectionMatrixBroadcast.  Effective per-entity coefficients in the
+    original shard space are C @ P — computed lazily for scoring (a single
+    [E,k]x[k,d] MXU matmul instead of the reference's per-entity
+    projectCoefficients map)."""
+
+    random_effect_type: str
+    feature_shard: str
+    task_type: str
+    latent_coefficients: jax.Array        # [E, k]
+    projection: jax.Array                 # [k, d_global]
+    entity_ids: np.ndarray                # [E] raw entity id values
+    global_dim: int
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.entity_ids)
+
+    @property
+    def latent_dim(self) -> int:
+        return self.latent_coefficients.shape[1]
+
+    def global_coefficients(self) -> jax.Array:
+        return self.latent_coefficients @ self.projection
+
+    def to_random_effect_model(self) -> RandomEffectModel:
+        """Original-space view (reference: FactoredRandomEffectModel
+        .toRandomEffectModel)."""
+        return RandomEffectModel(
+            random_effect_type=self.random_effect_type,
+            feature_shard=self.feature_shard, task_type=self.task_type,
+            coefficients=self.global_coefficients(), entity_ids=self.entity_ids,
+            projection=None, global_dim=self.global_dim)
+
+    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+        return self.to_random_effect_model().score_dataset(dataset)
+
+    def summary(self) -> str:
+        return (f"FactoredRandomEffectModel(type={self.random_effect_type}, "
+                f"shard={self.feature_shard}, entities={self.num_entities}, "
+                f"latent_dim={self.latent_dim})")
+
+
+@dataclasses.dataclass
+class MatrixFactorizationModel:
+    """score(row, col) = rowFactor . colFactor.
+
+    reference: MatrixFactorizationModel (photon-api/.../model/
+    MatrixFactorizationModel.scala:36-291) — RDDs of (id, Vector) latent
+    factors; here two dense [*, k] arrays + host-side id arrays.  Like the
+    reference (modelType = TaskType.NONE), this model is task-agnostic:
+    task_type "none" is exempt from GameModel's consistency check."""
+
+    row_effect_type: str
+    col_effect_type: str
+    row_factors: jax.Array                # [R, k]
+    row_ids: np.ndarray                   # [R] raw entity id values
+    col_factors: jax.Array                # [C, k]
+    col_ids: np.ndarray                   # [C] raw entity id values
+    task_type: str = "none"
+
+    @property
+    def num_latent_factors(self) -> int:
+        """reference: MatrixFactorizationModel.numLatentFactors."""
+        if self.row_factors.shape[0]:
+            return self.row_factors.shape[1]
+        if self.col_factors.shape[0]:
+            return self.col_factors.shape[1]
+        return 0
+
+    @staticmethod
+    def _lanes(dataset: GameDataset, effect_type: str, ids: np.ndarray) -> np.ndarray:
+        vocab = dataset.entity_vocabs[effect_type]
+        lookup = {v: i for i, v in enumerate(ids.tolist())}
+        vocab_to_lane = np.asarray([lookup.get(v, -1) for v in vocab.tolist()],
+                                   dtype=np.int64)
+        idx = dataset.entity_indices[effect_type]
+        return np.where(idx >= 0, vocab_to_lane[np.maximum(idx, 0)], -1)
+
+    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+        """rowFactor.colFactor per row; either side unseen -> 0 (reference:
+        MatrixFactorizationModel.score inner join — missing pairs default)."""
+        rl = jnp.asarray(self._lanes(dataset, self.row_effect_type, self.row_ids))
+        cl = jnp.asarray(self._lanes(dataset, self.col_effect_type, self.col_ids))
+        ok = (rl >= 0) & (cl >= 0)
+        rf = self.row_factors[jnp.maximum(rl, 0)]
+        cf = self.col_factors[jnp.maximum(cl, 0)]
+        return jnp.where(ok, jnp.sum(rf * cf, axis=-1), 0.0)
+
+    @staticmethod
+    def from_factored(model: FactoredRandomEffectModel,
+                      col_effect_type: str,
+                      col_ids: np.ndarray) -> "MatrixFactorizationModel":
+        """When the factored RE's feature shard is a one-hot indicator of a
+        second entity (no intercept), c_e . (P x) == c_e . P[:, col]: rows
+        are the RE entities, columns are the projection's columns."""
+        if len(col_ids) != model.projection.shape[1]:
+            raise ValueError(
+                f"col_ids has {len(col_ids)} entries but the projection has "
+                f"{model.projection.shape[1]} columns — the feature shard "
+                "must be a one-hot column indicator")
+        return MatrixFactorizationModel(
+            row_effect_type=model.random_effect_type,
+            col_effect_type=col_effect_type,
+            row_factors=model.latent_coefficients,
+            row_ids=model.entity_ids,
+            col_factors=model.projection.T,
+            col_ids=np.asarray(col_ids))
+
+    def summary(self) -> str:
+        return (f"MatrixFactorizationModel(rows={self.row_effect_type}x"
+                f"{len(self.row_ids)}, cols={self.col_effect_type}x"
+                f"{len(self.col_ids)}, k={self.num_latent_factors})")
+
+
+CoordinateModel = (FixedEffectModel | RandomEffectModel
+                   | FactoredRandomEffectModel | MatrixFactorizationModel)
 
 
 @dataclasses.dataclass
@@ -119,7 +251,9 @@ class GameModel:
 
     def __post_init__(self):
         for name, m in self.coordinates.items():
-            if m.task_type != self.task_type:
+            # "none" = task-agnostic (matrix factorization; reference sets
+            # modelType = TaskType.NONE for it, MatrixFactorizationModel.scala)
+            if m.task_type not in (self.task_type, "none"):
                 raise ValueError(
                     f"coordinate {name!r} has task {m.task_type!r}, "
                     f"expected {self.task_type!r} (reference: GameModel task "

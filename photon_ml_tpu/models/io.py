@@ -29,7 +29,10 @@ import numpy as np
 from photon_ml_tpu.data.index_map import IndexMap
 from photon_ml_tpu.game.config import GameTrainingConfig
 from photon_ml_tpu.models.coefficients import Coefficients
-from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.game import (
+    FactoredRandomEffectModel, FixedEffectModel, GameModel,
+    MatrixFactorizationModel, RandomEffectModel,
+)
 from photon_ml_tpu.models.glm import model_for_task
 
 _FORMAT_VERSION = 1
@@ -66,6 +69,8 @@ def save_game_model(
                       "global_dim": np.asarray(m.global_dim)}
             if m.projection is not None:
                 arrays["projection"] = m.projection
+            if m.projection_matrix is not None:
+                arrays["projection_matrix"] = np.asarray(m.projection_matrix)
             if m.variances is not None:
                 arrays["variances"] = np.asarray(m.variances)
             np.savez_compressed(os.path.join(sub, "coefficients.npz"), **arrays)
@@ -73,6 +78,36 @@ def save_game_model(
                 "kind": "random_effect",
                 "random_effect_type": m.random_effect_type,
                 "feature_shard": m.feature_shard}
+        elif isinstance(m, FactoredRandomEffectModel):
+            sub = os.path.join(directory, "factored-random-effect", name)
+            os.makedirs(sub, exist_ok=True)
+            np.savez_compressed(
+                os.path.join(sub, "coefficients.npz"),
+                latent_coefficients=np.asarray(m.latent_coefficients),
+                projection=np.asarray(m.projection),
+                entity_ids=np.asarray(m.entity_ids).astype(object),
+                global_dim=np.asarray(m.global_dim))
+            meta["coordinates"][name] = {
+                "kind": "factored_random_effect",
+                "random_effect_type": m.random_effect_type,
+                "feature_shard": m.feature_shard}
+        elif isinstance(m, MatrixFactorizationModel):
+            # reference: ModelProcessingUtils matrix-factorization save/load
+            # (scala:450-516) — row/col latent factors (LatentFactorAvro
+            # export lives in data/avro_io.py write_latent_factors_avro)
+            sub = os.path.join(directory, "matrix-factorization", name)
+            os.makedirs(sub, exist_ok=True)
+            np.savez_compressed(
+                os.path.join(sub, "factors.npz"),
+                row_factors=np.asarray(m.row_factors),
+                row_ids=np.asarray(m.row_ids).astype(object),
+                col_factors=np.asarray(m.col_factors),
+                col_ids=np.asarray(m.col_ids).astype(object))
+            meta["coordinates"][name] = {
+                "kind": "matrix_factorization",
+                "row_effect_type": m.row_effect_type,
+                "col_effect_type": m.col_effect_type,
+                "task_type": m.task_type}
         else:
             raise TypeError(f"unknown coordinate model type {type(m)}")
     with open(os.path.join(directory, "model-metadata.json"), "w") as f:
@@ -95,6 +130,26 @@ def load_game_model(directory: str
                 jnp.asarray(z["variances"]) if "variances" in z else None)
             coords[name] = FixedEffectModel(model_for_task(task, coeffs),
                                             info["feature_shard"])
+        elif info["kind"] == "factored_random_effect":
+            z = np.load(os.path.join(directory, "factored-random-effect", name,
+                                     "coefficients.npz"), allow_pickle=True)
+            coords[name] = FactoredRandomEffectModel(
+                random_effect_type=info["random_effect_type"],
+                feature_shard=info["feature_shard"],
+                task_type=task,
+                latent_coefficients=jnp.asarray(z["latent_coefficients"]),
+                projection=jnp.asarray(z["projection"]),
+                entity_ids=z["entity_ids"],
+                global_dim=int(z["global_dim"]))
+        elif info["kind"] == "matrix_factorization":
+            z = np.load(os.path.join(directory, "matrix-factorization", name,
+                                     "factors.npz"), allow_pickle=True)
+            coords[name] = MatrixFactorizationModel(
+                row_effect_type=info["row_effect_type"],
+                col_effect_type=info["col_effect_type"],
+                row_factors=jnp.asarray(z["row_factors"]), row_ids=z["row_ids"],
+                col_factors=jnp.asarray(z["col_factors"]), col_ids=z["col_ids"],
+                task_type=info.get("task_type", "none"))
         else:
             z = np.load(os.path.join(directory, "random-effect", name,
                                      "coefficients.npz"), allow_pickle=True)
@@ -106,7 +161,9 @@ def load_game_model(directory: str
                 entity_ids=z["entity_ids"],
                 projection=z["projection"] if "projection" in z else None,
                 global_dim=int(z["global_dim"]),
-                variances=jnp.asarray(z["variances"]) if "variances" in z else None)
+                variances=jnp.asarray(z["variances"]) if "variances" in z else None,
+                projection_matrix=(z["projection_matrix"]
+                                   if "projection_matrix" in z else None))
     config = (GameTrainingConfig.from_dict(meta["config"])
               if meta.get("config") else None)
     return GameModel(coords, task), config

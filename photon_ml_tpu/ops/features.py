@@ -1,24 +1,68 @@
-"""Feature-matrix abstraction: dense jnp arrays or sparse BCOO.
+"""Feature-matrix abstraction: dense arrays, sparse BCOO, implicit Kronecker.
 
 The reference streams Breeze sparse/dense vectors per datum (reference:
 photon-lib/.../data/DataPoint.scala, util/VectorUtils.scala).  On TPU the unit
 of work is the whole batch: a feature matrix X of shape [n, d], either dense
 (the common case after densification — e.g. a1a is d=123, the Yahoo! Music
-fixture d=14,983) or `jax.experimental.sparse.BCOO` when d is large and rows
-are sparse.  Every kernel in ops/aggregators.py only touches X through the
-three products below, so both representations (and future pallas kernels)
-plug in transparently.  Both are pytrees, so they flow through
-jit/vmap/shard_map unchanged.
+fixture d=14,983), `jax.experimental.sparse.BCOO` when d is large and rows
+are sparse, or `KroneckerDesign` — an IMPLICIT design matrix whose row i is
+kron(factors_i, x_i), used by the factored-random-effect latent refit.  Every
+kernel in ops/aggregators.py only touches X through the products below, so
+all representations (and future pallas kernels) plug in transparently.  All
+are pytrees, so they flow through jit/vmap/shard_map unchanged.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Union
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
-FeatureMatrix = Union[jax.Array, jsparse.BCOO]
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KroneckerDesign:
+    """Implicit [n, k*d] design matrix with row_i = kron(factors_i, x_i).
+
+    The reference MATERIALIZES this matrix when refitting the latent
+    projection of a factored random effect — one k*d-dim dense vector per
+    datum shuffled through Spark (reference: FactoredRandomEffectCoordinate
+    .kroneckerProductFeaturesAndCoefficients + VectorUtils.kroneckerProduct).
+    Here the products are computed directly from X [n, d] and the per-row
+    latent factors C [n, k]:
+      matvec(P_flat)   = ((X @ P^T) * C).sum(-1)        — two MXU matmuls
+      rmatvec(u)       = (C * u[:, None])^T @ X         — one MXU matmul
+    so the k*d matrix never exists and HBM traffic stays O(n(d+k))."""
+
+    x: jax.Array        # [n, d]
+    factors: jax.Array  # [n, k]
+
+    def tree_flatten(self):
+        return (self.x, self.factors), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return (self.x.shape[0], self.factors.shape[1] * self.x.shape[1])
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self.x.dtype
+
+    def _unflatten_coef(self, v: jax.Array) -> jax.Array:
+        return v.reshape(self.factors.shape[1], self.x.shape[1])
+
+
+FeatureMatrix = Union[jax.Array, jsparse.BCOO, KroneckerDesign]
 
 
 def is_sparse(x: FeatureMatrix) -> bool:
@@ -35,11 +79,16 @@ def num_rows(x: FeatureMatrix) -> int:
 
 def matvec(x: FeatureMatrix, v: jax.Array) -> jax.Array:
     """X @ v -> [n].  The margin kernel."""
+    if isinstance(x, KroneckerDesign):
+        p = x._unflatten_coef(v)
+        return jnp.sum((x.x @ p.T) * x.factors, axis=-1)
     return x @ v
 
 
 def rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
     """X^T @ u -> [d].  The gradient-assembly kernel."""
+    if isinstance(x, KroneckerDesign):
+        return ((x.factors * u[:, None]).T @ x.x).reshape(-1)
     if is_sparse(x):
         # BCOO transpose-matvec: (u @ X) contracts over rows.
         return u @ x
@@ -49,6 +98,10 @@ def rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
 def sq_rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
     """(X*X)^T @ u -> [d].  Used by the Hessian-diagonal aggregator
     (reference: photon-lib/.../function/glm/HessianDiagonalAggregator.scala:33)."""
+    if isinstance(x, KroneckerDesign):
+        # kron(c, x)^2 == kron(c^2, x^2)
+        f2 = x.factors * x.factors
+        return ((f2 * u[:, None]).T @ (x.x * x.x)).reshape(-1)
     if is_sparse(x):
         x2 = jsparse.BCOO((x.data * x.data, x.indices), shape=x.shape,
                           indices_sorted=x.indices_sorted, unique_indices=x.unique_indices)
@@ -56,5 +109,22 @@ def sq_rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
     return (x * x).T @ u
 
 
+def pad_rows(x: FeatureMatrix, rem: int) -> FeatureMatrix:
+    """Append `rem` zero rows (mesh-alignment padding; pair with mask=0)."""
+    if rem == 0:
+        return x
+    zpad = lambda a: jnp.concatenate(
+        [a, jnp.zeros((rem,) + a.shape[1:], a.dtype)])
+    if isinstance(x, KroneckerDesign):
+        return KroneckerDesign(zpad(x.x), zpad(x.factors))
+    if is_sparse(x):
+        raise NotImplementedError(
+            "BCOO batches must arrive pre-padded to a multiple of the mesh "
+            "data axis (pad rows with mask=0 while building the dataset)")
+    return zpad(x)
+
+
 def densify(x: FeatureMatrix) -> jax.Array:
+    if isinstance(x, KroneckerDesign):
+        return jax.vmap(jnp.kron)(x.factors, x.x)
     return x.todense() if is_sparse(x) else x

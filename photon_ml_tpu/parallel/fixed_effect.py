@@ -51,12 +51,9 @@ def pad_batch_to_mesh(objective: GLMObjective, mesh: Mesh) -> GLMObjective:
     pad = lambda a, v: None if a is None else jnp.concatenate(
         [a, jnp.full((rem,) + a.shape[1:], v, a.dtype)]) if rem else a
     mask = objective.mask if objective.mask is not None else jnp.ones_like(objective.labels)
-    if hasattr(objective.x, "todense") and not isinstance(objective.x, jnp.ndarray):
-        raise NotImplementedError(
-            "BCOO batches must arrive pre-padded to a multiple of the mesh "
-            "data axis (pad rows with mask=0 while building the dataset)")
+    from photon_ml_tpu.ops import features as fops
     return objective.replace(
-        x=pad(objective.x, 0.0), labels=pad(objective.labels, 0.5),
+        x=fops.pad_rows(objective.x, rem), labels=pad(objective.labels, 0.5),
         weights=pad(objective.weights, 0.0), offsets=pad(objective.offsets, 0.0),
         mask=pad(mask, 0.0))
 
