@@ -24,22 +24,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluators", default=None)
     p.add_argument("--predict", action="store_true",
                    help="also emit mean predictions (inverse link)")
+    p.add_argument("--mesh", default="auto",
+                   help="'auto' = all local devices, 'none', or 'DxF'")
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    from photon_ml_tpu.cli.train import _load_dataset
+    from photon_ml_tpu.cli.train import _load_dataset, make_mesh_from_arg
     from photon_ml_tpu.evaluation import parse_evaluator
     from photon_ml_tpu.models.io import load_game_model
 
     model, _config = load_game_model(args.model_dir)
     ds = _load_dataset(args.data, model.task_type)
-    scores = np.asarray(model.score_dataset(ds))
+    mesh = make_mesh_from_arg(args.mesh)
+    scores = np.asarray(model.score_dataset(ds, mesh))
     out = {"scores": scores}
     if args.predict:
-        out["predictions"] = np.asarray(model.predict(ds))
+        out["predictions"] = np.asarray(model.predict(ds, mesh))
     np.savez_compressed(args.output if args.output.endswith(".npz")
                         else args.output + ".npz", **out)
 

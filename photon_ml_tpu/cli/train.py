@@ -55,7 +55,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-variances", action="store_true")
     p.add_argument("--x64", action="store_true", help="float64 (parity runs)")
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--mesh", default="auto",
+                   help="'auto' = all local devices on the data axis, 'none' "
+                        "= single device, or 'DxF' (e.g. '4x2' = 4-way data "
+                        "x 2-way feature sharding)")
+    # hyperparameter tuning (reference: GameTrainingParams tuning mode +
+    # Driver.runHyperparameterTuning, cli/game/training/Driver.scala:337-373)
+    p.add_argument("--tuning", default="none",
+                   choices=["none", "random", "bayesian"])
+    p.add_argument("--tuning-iterations", type=int, default=10)
+    p.add_argument("--tuning-range", default="-3,3",
+                   help="log10 lambda search range 'lo,hi' per coordinate")
     return p
+
+
+def make_mesh_from_arg(mesh_arg: str):
+    """'auto' | 'none' | 'DxF' -> Mesh or None.  The default builds a mesh
+    over ALL local devices — the distributed path IS the product path
+    (the reference driver is always distributed: Driver.scala:50-505)."""
+    if mesh_arg == "none":
+        return None
+    from photon_ml_tpu.parallel import make_mesh
+    if mesh_arg == "auto":
+        return make_mesh()
+    d, _, f = mesh_arg.partition("x")
+    return make_mesh(int(d), int(f) if f else 1)
 
 
 def _load_dataset(path: str, task: str):
@@ -94,11 +118,17 @@ def main(argv=None) -> int:
           f"{ {s: x.shape[1] for s, x in train.feature_shards.items()} }",
           file=sys.stderr)
 
+    mesh = make_mesh_from_arg(args.mesh)
+    if mesh is not None:
+        print(f"mesh: {dict(mesh.shape)} over {len(mesh.devices.ravel())} "
+              f"devices", file=sys.stderr)
+    evaluator_specs = args.evaluators.split(",") if args.evaluators else None
+
     if args.config:
         with open(args.config) as f:
             config = GameTrainingConfig.from_json(f.read())
-        results = [GameEstimator(config).fit(
-            train, val, args.evaluators.split(",") if args.evaluators else None)]
+        results = [GameEstimator(config, mesh=mesh).fit(
+            train, val, evaluator_specs)]
     else:
         # legacy single-GLM path: one FE coordinate, lambda sweep, best by
         # first validation evaluator (reference: Driver stage machine +
@@ -118,9 +148,29 @@ def main(argv=None) -> int:
                 "global", GLMOptimizationConfig(optimizer=opt, regularization=reg),
                 normalization=NormalizationType(args.normalization))},
             updating_sequence=["fixed"])
-        results = GameEstimator(config).fit_grid(
-            train, grid, val,
-            args.evaluators.split(",") if args.evaluators else None)
+        results = GameEstimator(config, mesh=mesh).fit_grid(
+            train, grid, val, evaluator_specs)
+
+    if args.tuning != "none":
+        # reference: Driver.runHyperparameterTuning — searcher seeded with
+        # the grid results, evaluation = refit with the candidate lambdas
+        if val is None:
+            raise SystemExit("--tuning requires --validation-data")
+        from photon_ml_tpu.hyperparameter import (
+            GameEstimatorEvaluationFunction, GaussianProcessSearch, RandomSearch)
+        fn = GameEstimatorEvaluationFunction(
+            GameEstimator(config, mesh=mesh), train, val, evaluator_specs,
+            scale="log")
+        lo, hi = (float(v) for v in args.tuning_range.split(","))
+        ranges = [(lo, hi)] * fn.num_params
+        spec0 = results[0].validation_specs[0]
+        if args.tuning == "bayesian":
+            search = GaussianProcessSearch(ranges, fn, spec0.evaluator,
+                                           seed=config.seed)
+        else:
+            search = RandomSearch(ranges, fn, seed=config.seed)
+        prior = [r for r in results if r.validation]
+        results = results + search.find(args.tuning_iterations, prior)
 
     from photon_ml_tpu.game.estimator import select_best_result
     best = select_best_result(results)

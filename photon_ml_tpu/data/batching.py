@@ -20,6 +20,7 @@ blocks; the training loop touches only dense arrays after this point.
 from __future__ import annotations
 
 import dataclasses
+import weakref
 from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
@@ -163,16 +164,37 @@ class RandomEffectDataset:
         return lanes
 
 
+# (dataset -> {(config, dtype) -> built blocks}) memo: grid sweeps and
+# hyperparameter tuning refit the same data under many lambdas — the blocks
+# depend only on (data, config, seed), never on the lambdas being searched
+_BUILD_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
 def build_random_effect_dataset(
     dataset: GameDataset,
     config: RandomEffectDataConfig,
     dtype=np.float64,
 ) -> RandomEffectDataset:
     """Group-by-entity -> cap -> select features -> project -> pad.
+    Memoized per (dataset, config, dtype) — see _BUILD_CACHE.
 
     reference call path: RandomEffectDataSet.apply (scala:240-277) +
     featureSelectionOnActiveData (scala:457-471) +
     RandomEffectDataSetInProjectedSpace.buildWithProjectorType."""
+    per_ds = _BUILD_CACHE.setdefault(dataset, {})
+    key = (config, np.dtype(dtype).name)
+    if key in per_ds:
+        return per_ds[key]
+    built = _build_random_effect_dataset(dataset, config, dtype)
+    per_ds[key] = built
+    return built
+
+
+def _build_random_effect_dataset(
+    dataset: GameDataset,
+    config: RandomEffectDataConfig,
+    dtype,
+) -> RandomEffectDataset:
     re_type = config.random_effect_type
     x_flat = np.asarray(dataset.feature_shards[config.feature_shard], dtype=dtype)
     y_flat = np.asarray(dataset.response, dtype=dtype)

@@ -37,7 +37,8 @@ class InputColumnNames:
     uid: str = "uid"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # identity semantics: holds arrays, and the
+# RE-dataset build memo (data/batching.py) weak-keys on dataset identity
 class GameDataset:
     """n rows in canonical order; everything else hangs off row position."""
 

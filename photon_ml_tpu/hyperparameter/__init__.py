@@ -1,0 +1,12 @@
+from photon_ml_tpu.hyperparameter.kernels import RBF, Matern52, StationaryKernel  # noqa: F401
+from photon_ml_tpu.hyperparameter.slice_sampler import SliceSampler  # noqa: F401
+from photon_ml_tpu.hyperparameter.gp import (  # noqa: F401
+    GaussianProcessEstimator, GaussianProcessModel, cholesky_solve,
+)
+from photon_ml_tpu.hyperparameter.search import (  # noqa: F401
+    ConfidenceBound, EvaluationFunction, ExpectedImprovement,
+    GaussianProcessSearch, RandomSearch,
+)
+from photon_ml_tpu.hyperparameter.game_evaluation import (  # noqa: F401
+    GameEstimatorEvaluationFunction,
+)

@@ -28,6 +28,9 @@ from photon_ml_tpu.ops import losses as L
 from photon_ml_tpu.parallel.random_effect import score_by_entity
 
 
+from photon_ml_tpu.parallel.mesh import pad_and_shard_rows as _sharded_rows
+
+
 @dataclasses.dataclass
 class FixedEffectModel:
     """One global GLM bound to a feature shard (reference:
@@ -41,8 +44,11 @@ class FixedEffectModel:
     def task_type(self) -> str:
         return type(self.glm).task_type
 
-    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+    def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
         x = jnp.asarray(dataset.feature_shards[self.feature_shard])
+        if mesh is not None:
+            from photon_ml_tpu.parallel.fixed_effect import score_fixed_effect
+            return score_fixed_effect(self.glm, x, mesh)
         return self.glm.compute_score(x)
 
     def summary(self) -> str:
@@ -104,9 +110,12 @@ class RandomEffectModel:
         lanes = np.where(idx >= 0, vocab_to_lane[np.maximum(idx, 0)], -1)
         return lanes
 
-    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+    def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
         x = jnp.asarray(dataset.feature_shards[self.feature_shard])
         lanes = jnp.asarray(self.lanes_for(dataset))
+        if mesh is not None:
+            n, (x, lanes) = _sharded_rows(mesh, x, lanes)
+            return score_by_entity(self.global_coefficients(), x, lanes)[:n]
         return score_by_entity(self.global_coefficients(), x, lanes)
 
     def summary(self) -> str:
@@ -154,8 +163,8 @@ class FactoredRandomEffectModel:
             coefficients=self.global_coefficients(), entity_ids=self.entity_ids,
             projection=None, global_dim=self.global_dim)
 
-    def score_dataset(self, dataset: GameDataset) -> jax.Array:
-        return self.to_random_effect_model().score_dataset(dataset)
+    def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
+        return self.to_random_effect_model().score_dataset(dataset, mesh)
 
     def summary(self) -> str:
         return (f"FactoredRandomEffectModel(type={self.random_effect_type}, "
@@ -199,15 +208,20 @@ class MatrixFactorizationModel:
         idx = dataset.entity_indices[effect_type]
         return np.where(idx >= 0, vocab_to_lane[np.maximum(idx, 0)], -1)
 
-    def score_dataset(self, dataset: GameDataset) -> jax.Array:
+    def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
         """rowFactor.colFactor per row; either side unseen -> 0 (reference:
         MatrixFactorizationModel.score inner join — missing pairs default)."""
         rl = jnp.asarray(self._lanes(dataset, self.row_effect_type, self.row_ids))
         cl = jnp.asarray(self._lanes(dataset, self.col_effect_type, self.col_ids))
+        n = rl.shape[0]
+        if mesh is not None:
+            # pad with -1 (unseen) so padding rows score 0
+            n, (rl, cl) = _sharded_rows(mesh, rl + 1, cl + 1)
+            rl, cl = rl - 1, cl - 1
         ok = (rl >= 0) & (cl >= 0)
         rf = self.row_factors[jnp.maximum(rl, 0)]
         cf = self.col_factors[jnp.maximum(cl, 0)]
-        return jnp.where(ok, jnp.sum(rf * cf, axis=-1), 0.0)
+        return jnp.where(ok, jnp.sum(rf * cf, axis=-1), 0.0)[:n]
 
     @staticmethod
     def from_factored(model: FactoredRandomEffectModel,
@@ -263,15 +277,17 @@ class GameModel:
     def loss(self) -> L.PointwiseLoss:
         return L.TASK_LOSSES[self.task_type]
 
-    def score_dataset(self, dataset: GameDataset) -> jax.Array:
-        """Sum of coordinate margins (reference: GameModel.scala:101-112)."""
+    def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
+        """Sum of coordinate margins (reference: GameModel.scala:101-112).
+        With a mesh, every coordinate scores row-sharded over the data axis
+        (the reference's scoring driver is always distributed)."""
         total = jnp.zeros(dataset.num_rows)
         for m in self.coordinates.values():
-            total = total + m.score_dataset(dataset)
+            total = total + m.score_dataset(dataset, mesh)
         return total
 
-    def predict(self, dataset: GameDataset) -> jax.Array:
-        z = self.score_dataset(dataset)
+    def predict(self, dataset: GameDataset, mesh=None) -> jax.Array:
+        z = self.score_dataset(dataset, mesh)
         if dataset.offsets is not None:
             z = z + jnp.asarray(dataset.offsets)
         return self.loss.mean(z)

@@ -115,15 +115,11 @@ def score_fixed_effect(model: GeneralizedLinearModel, x, mesh: Mesh,
     back sharded over "data" — they stay device-resident for coordinate
     descent's residual exchange.  Rows are padded to a mesh multiple and the
     padding sliced off the result."""
-    n = x.shape[0]
-    rem = (-n) % mesh.shape[DATA_AXIS]
-    if rem:
-        x = jnp.concatenate([x, jnp.zeros((rem,) + x.shape[1:], x.dtype)])
-        if offsets is not None:
-            offsets = jnp.concatenate([offsets, jnp.zeros((rem,), offsets.dtype)])
-    x = jax.device_put(x, data_sharding(mesh, x.ndim))
-    if offsets is not None:
-        offsets = jax.device_put(offsets, data_sharding(mesh, offsets.ndim))
+    from photon_ml_tpu.parallel.mesh import pad_and_shard_rows
+    if offsets is None:
+        n, (x,) = pad_and_shard_rows(mesh, x)
+    else:
+        n, (x, offsets) = pad_and_shard_rows(mesh, x, offsets)
     with mesh:
         scores = _cached_scorer()(model.coefficients.means, x, offsets)
-    return scores[:n] if rem else scores
+    return scores[:n]

@@ -32,6 +32,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -68,6 +69,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def feature_sharding(mesh: Mesh) -> NamedSharding:
     """[d] vectors split over the "feature" axis (wide fixed-effect models)."""
     return NamedSharding(mesh, P(FEATURE_AXIS))
+
+
+def pad_and_shard_rows(mesh: Mesh, *arrays):
+    """Pad row-leading arrays with zeros to a data-axis multiple and place
+    them sharded over "data".  Returns (original_n, [padded arrays...]);
+    callers slice results back to original_n.  The one shared implementation
+    of the pad/shard/slice pattern used by distributed scoring and training
+    entry points."""
+    n = arrays[0].shape[0]
+    rem = (-n) % mesh.shape[DATA_AXIS]
+    out = []
+    for a in arrays:
+        a = jnp.asarray(a)
+        if rem:
+            a = jnp.concatenate([a, jnp.zeros((rem,) + a.shape[1:], a.dtype)])
+        out.append(jax.device_put(a, data_sharding(mesh, a.ndim)))
+    return n, out
 
 
 def shard_leading(tree, mesh: Mesh):
