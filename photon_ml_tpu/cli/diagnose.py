@@ -1,0 +1,129 @@
+"""Diagnostics CLI: trained model + data -> JSON/markdown quality report.
+
+reference: the legacy Driver's DIAGNOSED stage (photon-client/.../
+Driver.scala:468-607), which assembles metrics, Hosmer-Lemeshow, bootstrap,
+feature importance, and fitting diagnostics into an HTML report.  Here the
+same analyses emit report.json + report.md.
+
+  python -m photon_ml_tpu.cli.diagnose --model-dir out/best --data d.npz \
+      --output-dir diag/ [--coordinate fixed] [--bootstrap-samples 10]
+      [--skip-fitting] [--skip-bootstrap]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-ml-tpu-diagnose")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--data", required=True, help=".npz GameDataset or .libsvm")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--coordinate", default=None,
+                   help="fixed-effect coordinate to analyze in depth "
+                        "(default: the first fixed-effect coordinate)")
+    p.add_argument("--bootstrap-samples", type=int, default=10)
+    p.add_argument("--skip-bootstrap", action="store_true")
+    p.add_argument("--skip-fitting", action="store_true")
+    p.add_argument("--x64", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import jax
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+
+    from photon_ml_tpu.cli.train import _load_dataset
+    from photon_ml_tpu.data.stats import BasicStatisticalSummary
+    from photon_ml_tpu.diagnostics import (
+        DiagnosticReport, bootstrap_training, evaluate_scores,
+        feature_importance, fitting_diagnostic, hosmer_lemeshow,
+        kendall_tau_analysis, render_markdown,
+    )
+    from photon_ml_tpu.game.config import FixedEffectCoordinateConfig
+    from photon_ml_tpu.models.game import FixedEffectModel
+    from photon_ml_tpu.models.io import load_game_model
+    from photon_ml_tpu.ops import TASK_LOSSES
+
+    model, config = load_game_model(args.model_dir)
+    ds = _load_dataset(args.data, model.task_type)
+    task = model.task_type
+    loss = TASK_LOSSES[task]
+
+    # full-model metrics from the composite score (margins + offsets)
+    import jax.numpy as jnp
+    margins = np.asarray(model.score_dataset(ds), dtype=np.float64)
+    if ds.offsets is not None:
+        margins = margins + ds.offsets
+    preds = np.asarray(loss.mean(jnp.asarray(margins)))
+
+    # the in-depth single-GLM analyses run on a fixed-effect coordinate
+    fe_name, fe_model = None, None
+    for name, m in model.coordinates.items():
+        if isinstance(m, FixedEffectModel) and (
+                args.coordinate is None or name == args.coordinate):
+            fe_name, fe_model = name, m
+            break
+    if args.coordinate is not None and fe_name != args.coordinate:
+        raise SystemExit(f"no fixed-effect coordinate {args.coordinate!r}")
+
+    coefs = (np.asarray(fe_model.glm.coefficients.means)
+             if fe_model is not None else None)
+    metrics = evaluate_scores(task, preds, margins, ds.response,
+                              coefficients=coefs)
+    report = DiagnosticReport(task_type=task, metrics=metrics)
+
+    if fe_model is not None:
+        x = ds.feature_shards[fe_model.feature_shard]
+        summary = BasicStatisticalSummary.from_features(
+            np.asarray(x), ds.weights)
+        imap = ds.index_maps.get(fe_model.feature_shard)
+        keys = imap.index_to_key if imap is not None else None
+        report.feature_importance = feature_importance(
+            coefs, summary, keys, "expected_magnitude")
+
+        if task == "logistic_regression":
+            report.hosmer_lemeshow = hosmer_lemeshow(preds, ds.response,
+                                                     x.shape[1])
+        report.independence = kendall_tau_analysis(preds, ds.response - preds)
+
+        opt = None
+        if config is not None and fe_name in config.coordinates:
+            c = config.coordinates[fe_name]
+            if isinstance(c, FixedEffectCoordinateConfig):
+                opt = c.optimization
+        kw = dict(
+            optimizer_config=opt.optimizer if opt else None,
+            regularization=opt.regularization if opt else None,
+            regularization_weight=opt.regularization_weight if opt else 0.0)
+        kw = {k: v for k, v in kw.items() if v is not None}
+        if not args.skip_bootstrap:
+            report.bootstrap = bootstrap_training(
+                x, ds.response, task,
+                num_bootstrap_samples=args.bootstrap_samples,
+                weights=ds.weights, offsets=ds.offsets, **kw)
+        if not args.skip_fitting:
+            report.fitting = fitting_diagnostic(
+                x, ds.response, task, weights=ds.weights, offsets=ds.offsets,
+                **kw)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    with open(os.path.join(args.output_dir, "report.json"), "w") as f:
+        f.write(report.to_json())
+    with open(os.path.join(args.output_dir, "report.md"), "w") as f:
+        f.write(render_markdown(report))
+    print(json.dumps({"metrics": metrics,
+                      "coordinate": fe_name,
+                      "output": args.output_dir}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

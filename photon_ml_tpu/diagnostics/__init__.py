@@ -1,0 +1,9 @@
+from photon_ml_tpu.diagnostics.metrics import evaluate_glm, evaluate_scores  # noqa: F401
+from photon_ml_tpu.diagnostics.bootstrap import (  # noqa: F401
+    BootstrapReport, CoefficientSummary, bootstrap_training,
+)
+from photon_ml_tpu.diagnostics.hl import HosmerLemeshowReport, hosmer_lemeshow  # noqa: F401
+from photon_ml_tpu.diagnostics.independence import KendallTauReport, kendall_tau_analysis  # noqa: F401
+from photon_ml_tpu.diagnostics.importance import FeatureImportanceReport, feature_importance  # noqa: F401
+from photon_ml_tpu.diagnostics.fitting import FittingReport, fitting_diagnostic  # noqa: F401
+from photon_ml_tpu.diagnostics.report import DiagnosticReport, render_markdown  # noqa: F401

@@ -1,0 +1,155 @@
+"""Bootstrap training: coefficient and metric confidence intervals.
+
+Rebuild of photon-diagnostics/.../BootstrapTraining.scala:29-181 +
+CoefficientSummary.scala + BootstrapTrainingDiagnostic.scala.
+
+The reference tags every row with one of 1000 random splits and, per
+bootstrap replica, filters the RDD into train/holdout subsets and runs a full
+Spark training job (strategy P7, SURVEY §2.14).  TPU design: a replica IS a
+weight vector.  Row membership for all k replicas is drawn as a [k, n] 0/1
+matrix, training weights = w * member, holdout weights = w * (1-member), and
+ALL k solves run as ONE vmapped XLA program over the replica axis — no data
+movement, no per-replica jobs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.diagnostics.metrics import MetricsMap, evaluate_scores
+from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+from photon_ml_tpu.optim import (
+    OptimizerConfig, RegularizationContext, solve,
+)
+
+
+@dataclasses.dataclass
+class CoefficientSummary:
+    """Five-number summary + mean/std over bootstrap replicas (reference:
+    supervised/model/CoefficientSummary.scala — quartiles/min/max)."""
+
+    min: float
+    q1: float
+    median: float
+    q3: float
+    max: float
+    mean: float
+    std: float
+
+    @staticmethod
+    def from_samples(samples: np.ndarray) -> "CoefficientSummary":
+        s = np.asarray(samples, dtype=np.float64)
+        q1, med, q3 = np.percentile(s, [25, 50, 75])
+        return CoefficientSummary(float(s.min()), float(q1), float(med),
+                                  float(q3), float(s.max()),
+                                  float(s.mean()), float(s.std()))
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class BootstrapReport:
+    num_samples: int
+    # per-coefficient summaries, 1:1 with the coefficient vector
+    coefficient_summaries: List[CoefficientSummary]
+    # metric name -> summary over replicas (holdout evaluation)
+    metric_summaries: Dict[str, CoefficientSummary]
+    # fraction of replicas where the coefficient's IQR excludes zero
+    significant_mask: np.ndarray
+
+    def to_dict(self) -> dict:
+        return {
+            "num_samples": self.num_samples,
+            "coefficient_summaries": [c.to_dict() for c in self.coefficient_summaries],
+            "metric_summaries": {k: v.to_dict() for k, v in self.metric_summaries.items()},
+            "num_significant": int(self.significant_mask.sum()),
+        }
+
+
+@functools.lru_cache(maxsize=32)
+def _replica_solver(loss, config: OptimizerConfig, reg: RegularizationContext):
+    # only the per-replica weight row varies; data/offsets are shared
+    def solve_one(x, labels, weights, offsets, x0, lam):
+        obj = GLMObjective(loss, x, labels, weights=weights, offsets=offsets)
+        return solve(obj, x0, config, reg, lam)
+    return jax.jit(jax.vmap(solve_one, in_axes=(None, None, 0, None, None, None)))
+
+
+def bootstrap_training(
+    x,
+    labels,
+    task_type: str,
+    *,
+    num_bootstrap_samples: int = 10,
+    training_portion: float = 0.75,
+    weights: Optional[np.ndarray] = None,
+    offsets: Optional[np.ndarray] = None,
+    optimizer_config: OptimizerConfig = OptimizerConfig(),
+    regularization: RegularizationContext = RegularizationContext(),
+    regularization_weight: float = 0.0,
+    warm_start: Optional[np.ndarray] = None,
+    seed: int = 7,
+) -> BootstrapReport:
+    """Train k replica models on random subsamples, evaluate each on its
+    holdout, aggregate coefficient + metric CIs.
+
+    reference: BootstrapTraining.bootstrap (scala:132-181; split-tag
+    subsampling with the `populationPortionPerBootstrapSample` cap at 0.9)
+    plus aggregateCoefficient/MetricsConfidenceIntervals (scala:48-100).
+    """
+    if num_bootstrap_samples <= 1:
+        raise ValueError("number of bootstrap samples must be > 1")
+    if not 0.0 < training_portion <= 1.0:
+        raise ValueError("training portion must be in (0, 1]")
+    portion = min(0.9, training_portion)  # reference: never more than 90%
+
+    x = jnp.asarray(np.asarray(x))
+    y = jnp.asarray(np.asarray(labels, dtype=x.dtype))
+    n, d = x.shape
+    base_w = (np.ones(n) if weights is None
+              else np.asarray(weights, dtype=np.float64))
+    rng = np.random.default_rng(seed)
+    member = (rng.random((num_bootstrap_samples, n)) < portion)
+    train_w = jnp.asarray(member * base_w, x.dtype)
+    x0 = (jnp.zeros((d,), x.dtype) if warm_start is None
+          else jnp.asarray(warm_start, x.dtype))
+
+    loss = TASK_LOSSES[task_type]
+    off = None if offsets is None else jnp.asarray(np.asarray(offsets), x.dtype)
+    solver = _replica_solver(loss, optimizer_config, regularization)
+    res = solver(x, y, train_w, off, x0, jnp.asarray(regularization_weight, x.dtype))
+    coefs = np.asarray(res.x)                       # [k, d]
+
+    # holdout metrics per replica (host-side reporting loop)
+    margins_all = np.asarray(x @ res.x.T).T         # [k, n]
+    if offsets is not None:
+        margins_all = margins_all + np.asarray(offsets)
+    per_metric: Dict[str, List[float]] = {}
+    labels_np = np.asarray(labels, dtype=np.float64)
+    for r in range(num_bootstrap_samples):
+        hold = ~member[r]
+        if not hold.any():
+            continue
+        margins = margins_all[r, hold]
+        preds = np.asarray(loss.mean(jnp.asarray(margins)))
+        metrics = evaluate_scores(task_type, preds, margins, labels_np[hold],
+                                  coefficients=coefs[r])
+        for k_, v in metrics.items():
+            per_metric.setdefault(k_, []).append(v)
+
+    coef_summaries = [CoefficientSummary.from_samples(coefs[:, j])
+                      for j in range(d)]
+    metric_summaries = {k_: CoefficientSummary.from_samples(np.asarray(v))
+                        for k_, v in per_metric.items()}
+    significant = np.asarray([(c.q1 > 0) or (c.q3 < 0) for c in coef_summaries])
+    return BootstrapReport(
+        num_samples=num_bootstrap_samples,
+        coefficient_summaries=coef_summaries,
+        metric_summaries=metric_summaries,
+        significant_mask=significant)

@@ -1,0 +1,93 @@
+"""Fitting diagnostic: learning curves over growing training portions.
+
+Rebuild of photon-diagnostics/.../fitting/FittingDiagnostic.scala:33-131:
+tag rows into 10 partitions, hold partition 9 out, train on growing prefixes
+(1/9, 2/9, ... of the non-holdout data) with warm starts, record each metric
+on train and holdout per portion.  Subsets are weight masks over the shared
+feature matrix — no data movement.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.diagnostics.metrics import MetricsMap, evaluate_scores
+from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+from photon_ml_tpu.optim import (
+    OptimizerConfig, RegularizationContext, solve,
+)
+
+NUM_TRAINING_PARTITIONS = 10          # reference: FittingDiagnostic object
+MIN_SAMPLES_PER_PARTITION_PER_DIMENSION = 10
+
+
+@dataclasses.dataclass
+class FittingReport:
+    # metric -> {"portions": [...], "train": [...], "test": [...]}
+    metrics: Dict[str, Dict[str, List[float]]]
+    message: str = ""
+
+    def to_dict(self) -> dict:
+        return {"metrics": self.metrics, "message": self.message}
+
+
+def fitting_diagnostic(
+    x,
+    labels,
+    task_type: str,
+    *,
+    weights: Optional[np.ndarray] = None,
+    offsets: Optional[np.ndarray] = None,
+    optimizer_config: OptimizerConfig = OptimizerConfig(),
+    regularization: RegularizationContext = RegularizationContext(),
+    regularization_weight: float = 0.0,
+    seed: int = 7,
+) -> FittingReport:
+    """reference: FittingDiagnostic.diagnose.  Returns an empty report when
+    there is not enough data (reference: numSamples <= dim * 10 guard)."""
+    x = jnp.asarray(np.asarray(x))
+    n, d = x.shape
+    if n <= d * MIN_SAMPLES_PER_PARTITION_PER_DIMENSION:
+        return FittingReport({}, message=(
+            f"not enough data for learning curves: {n} rows <= "
+            f"{d * MIN_SAMPLES_PER_PARTITION_PER_DIMENSION}"))
+
+    y = jnp.asarray(np.asarray(labels, dtype=np.float64), x.dtype)
+    base_w = (np.ones(n) if weights is None
+              else np.asarray(weights, dtype=np.float64))
+    rng = np.random.default_rng(seed)
+    tags = rng.integers(0, NUM_TRAINING_PARTITIONS, size=n)
+    holdout = tags == NUM_TRAINING_PARTITIONS - 1
+    off = None if offsets is None else jnp.asarray(np.asarray(offsets), x.dtype)
+    loss = TASK_LOSSES[task_type]
+    labels_np = np.asarray(labels, dtype=np.float64)
+
+    curves: Dict[str, Dict[str, List[float]]] = {}
+    x0 = jnp.zeros((d,), x.dtype)
+    lam = jnp.asarray(regularization_weight, x.dtype)
+    for max_tag in range(NUM_TRAINING_PARTITIONS - 1):
+        member = tags <= max_tag
+        portion = 100.0 * member.sum() / n
+        w = jnp.asarray(member * base_w, x.dtype)
+        obj = GLMObjective(loss, x, y, weights=w, offsets=off)
+        res = solve(obj, x0, optimizer_config, regularization, lam)
+        x0 = res.x  # warm start the next, larger portion (reference scanLeft)
+        margins = np.asarray(x @ res.x)
+        if offsets is not None:
+            margins = margins + np.asarray(offsets)
+        preds = np.asarray(loss.mean(jnp.asarray(margins)))
+        coefs = np.asarray(res.x)
+        m_train = evaluate_scores(task_type, preds[member], margins[member],
+                                  labels_np[member], coefficients=coefs)
+        m_test = evaluate_scores(task_type, preds[holdout], margins[holdout],
+                                 labels_np[holdout], coefficients=coefs)
+        for metric, v_test in m_test.items():
+            entry = curves.setdefault(
+                metric, {"portions": [], "train": [], "test": []})
+            entry["portions"].append(round(portion, 2))
+            entry["train"].append(m_train.get(metric, float("nan")))
+            entry["test"].append(v_test)
+    return FittingReport(curves)

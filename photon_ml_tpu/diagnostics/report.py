@@ -1,0 +1,120 @@
+"""Diagnostic report assembly + JSON/markdown rendering.
+
+Replaces the reference's HTML reporting framework (photon-diagnostics/
+.../diagnostics/reporting/ — LogicalReport -> PhysicalReport -> xchart/batik
+HTML, ~1500 LoC).  Per SURVEY §7 ("What NOT to port"), rendering is JSON +
+markdown: the ANALYSES carry the value, the presentation layer does not.
+Assembled per the legacy driver's diagnose stage (Driver.scala:468-607):
+metrics + Hosmer-Lemeshow + bootstrap + feature importance + fitting curves
++ prediction-error independence.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from photon_ml_tpu.diagnostics.bootstrap import BootstrapReport
+from photon_ml_tpu.diagnostics.fitting import FittingReport
+from photon_ml_tpu.diagnostics.hl import HosmerLemeshowReport
+from photon_ml_tpu.diagnostics.importance import FeatureImportanceReport
+from photon_ml_tpu.diagnostics.independence import KendallTauReport
+
+
+@dataclasses.dataclass
+class DiagnosticReport:
+    task_type: str
+    metrics: Dict[str, float]
+    feature_importance: Optional[FeatureImportanceReport] = None
+    hosmer_lemeshow: Optional[HosmerLemeshowReport] = None
+    independence: Optional[KendallTauReport] = None
+    bootstrap: Optional[BootstrapReport] = None
+    fitting: Optional[FittingReport] = None
+
+    def to_dict(self) -> dict:
+        d = {"task_type": self.task_type, "metrics": self.metrics}
+        if self.feature_importance is not None:
+            d["feature_importance"] = self.feature_importance.to_dict()
+        if self.hosmer_lemeshow is not None:
+            d["hosmer_lemeshow"] = self.hosmer_lemeshow.to_dict()
+        if self.independence is not None:
+            d["independence"] = self.independence.to_dict()
+        if self.bootstrap is not None:
+            d["bootstrap"] = self.bootstrap.to_dict()
+        if self.fitting is not None:
+            d["fitting"] = self.fitting.to_dict()
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+
+def render_markdown(report: DiagnosticReport) -> str:
+    """Markdown rendering of the full report (the reference renders chapters/
+    sections/plots to HTML; same structure, portable format)."""
+    lines: List[str] = [f"# Model diagnostic report ({report.task_type})", ""]
+
+    lines += ["## Metrics", "", "| metric | value |", "|---|---|"]
+    for k, v in sorted(report.metrics.items()):
+        lines.append(f"| {k} | {v:.6g} |")
+    lines.append("")
+
+    if report.feature_importance is not None:
+        fi = report.feature_importance
+        lines += [f"## Feature importance ({fi.importance_type})", "",
+                  "| rank | feature | importance |", "|---|---|---|"]
+        for rank, (feat, _idx, imp) in enumerate(fi.top(20), 1):
+            lines.append(f"| {rank} | {feat} | {imp:.6g} |")
+        lines.append("")
+
+    if report.hosmer_lemeshow is not None:
+        hl = report.hosmer_lemeshow
+        lines += ["## Hosmer-Lemeshow calibration", "",
+                  f"- chi-squared: {hl.chi_squared:.4f} "
+                  f"({hl.degrees_of_freedom} dof)",
+                  f"- P(chi2 <= observed): {hl.prob_at_chi_square:.4f} "
+                  f"(p-value {hl.p_value:.4f})", ""]
+        lines += ["| bin | expected + | observed + | expected - | observed - |",
+                  "|---|---|---|---|---|"]
+        for b in hl.bins:
+            lines.append(f"| [{b.lower:.2f}, {b.upper:.2f}) | "
+                         f"{b.expected_pos:.1f} | {b.observed_pos:.0f} | "
+                         f"{b.expected_neg:.1f} | {b.observed_neg:.0f} |")
+        if hl.warnings:
+            lines += ["", f"warnings: {len(hl.warnings)} sparse bins"]
+        lines.append("")
+
+    if report.independence is not None:
+        kt = report.independence
+        lines += ["## Prediction-error independence (Kendall tau)", "",
+                  f"- tau-alpha: {kt.tau_alpha:.4f}, tau-beta: {kt.tau_beta:.4f}",
+                  f"- z: {kt.z_alpha:.3f}, two-sided probability: {kt.p_value:.4f}"]
+        if kt.message:
+            lines.append(f"- note: {kt.message}")
+        lines.append("")
+
+    if report.bootstrap is not None:
+        bs = report.bootstrap
+        lines += ["## Bootstrap confidence intervals", "",
+                  f"- replicas: {bs.num_samples}",
+                  f"- coefficients with IQR excluding zero: "
+                  f"{int(bs.significant_mask.sum())} / "
+                  f"{len(bs.coefficient_summaries)}", "",
+                  "| metric | q1 | median | q3 |", "|---|---|---|---|"]
+        for k, s in sorted(bs.metric_summaries.items()):
+            lines.append(f"| {k} | {s.q1:.6g} | {s.median:.6g} | {s.q3:.6g} |")
+        lines.append("")
+
+    if report.fitting is not None and report.fitting.metrics:
+        lines += ["## Learning curves", ""]
+        for metric, curve in sorted(report.fitting.metrics.items()):
+            lines += [f"### {metric}", "",
+                      "| train % | train | holdout |", "|---|---|---|"]
+            for p, tr, te in zip(curve["portions"], curve["train"],
+                                 curve["test"]):
+                lines.append(f"| {p:.1f} | {tr:.6g} | {te:.6g} |")
+            lines.append("")
+    elif report.fitting is not None:
+        lines += ["## Learning curves", "", report.fitting.message, ""]
+
+    return "\n".join(lines)

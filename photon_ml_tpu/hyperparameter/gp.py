@@ -64,8 +64,10 @@ class GaussianProcessModel:
         ktrans = kern(self.x_train, x)                     # [n_train, m]
         y_pred = ktrans.T @ alpha                          # line 4
         v = np.linalg.solve(l, ktrans)                     # line 5
-        y_cov = kern(x) - v.T @ v                          # line 6
-        return y_pred + self.y_mean, np.diag(y_cov).copy()
+        # line 6, diagonal only: diag(k(x,x) - v^T v) without the m x m
+        # candidate-covariance matrices (m = candidate pool, every iteration)
+        y_var = kern.diag(x) - np.sum(v * v, axis=0)
+        return y_pred + self.y_mean, y_var
 
     def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """(means, variances), averaged over the sampled kernels."""

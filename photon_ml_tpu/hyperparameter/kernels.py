@@ -54,6 +54,11 @@ class StationaryKernel:
         ls = self._expand(x1.shape[1])
         return self._from_sq_dists(_pairwise_sq_dists(x1 / ls, x2 / ls))
 
+    def diag(self, x: np.ndarray) -> np.ndarray:
+        """diag(k(x, x)) without the m x m matrix (stationary: k(0) per row)."""
+        k0 = float(self._from_sq_dists(np.zeros(1))[0])
+        return np.full(len(x), k0)
+
     # -- parameter vector surface (what the slice sampler walks) -------------
     def get_params(self) -> np.ndarray:
         """log length scales (reference: StationaryKernel.getParams)."""

@@ -1,0 +1,242 @@
+"""Diagnostics: extended metrics, bootstrap CIs, HL calibration, Kendall-tau,
+feature importance, fitting curves, report rendering.
+
+Mirrors photon-diagnostics test strategy: closed-form/sklearn-free checks on
+small fixtures with seeded RNGs, plus a CLI smoke test emitting the report.
+"""
+import json
+import math
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.diagnostics import (
+    BootstrapReport, DiagnosticReport, bootstrap_training, evaluate_glm,
+    evaluate_scores, feature_importance, fitting_diagnostic, hosmer_lemeshow,
+    kendall_tau_analysis, render_markdown,
+)
+from photon_ml_tpu.diagnostics import metrics as M
+from photon_ml_tpu.optim import RegularizationContext, RegularizationType
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+# -- metrics ------------------------------------------------------------------
+
+def test_binary_metrics_perfect_classifier():
+    preds = np.asarray([0.9, 0.8, 0.2, 0.1])
+    labels = np.asarray([1.0, 1.0, 0.0, 0.0])
+    m = evaluate_scores("logistic_regression", preds, np.log(preds / (1 - preds)),
+                        labels, coefficients=np.ones(3))
+    assert m[M.AREA_UNDER_ROC] == pytest.approx(1.0)
+    assert m[M.AREA_UNDER_PRECISION_RECALL] == pytest.approx(1.0)
+    assert m[M.PEAK_F1_SCORE] == pytest.approx(1.0)
+    assert M.AKAIKE_INFORMATION_CRITERION in m
+
+
+def test_auc_matches_rank_formulation(rng):
+    from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve
+    preds = rng.uniform(size=200)
+    labels = (rng.uniform(size=200) > 0.6).astype(float)
+    assert M.area_under_roc(preds, labels) == pytest.approx(
+        area_under_roc_curve(preds, labels), abs=1e-9)
+
+
+def test_regression_metrics():
+    preds = np.asarray([1.0, 2.0, 3.0])
+    labels = np.asarray([1.5, 2.0, 2.0])
+    m = evaluate_scores("linear_regression", preds, preds, labels)
+    assert m[M.MEAN_ABSOLUTE_ERROR] == pytest.approx(0.5)
+    assert m[M.MEAN_SQUARE_ERROR] == pytest.approx((0.25 + 0 + 1) / 3)
+    assert m[M.ROOT_MEAN_SQUARE_ERROR] == pytest.approx(
+        math.sqrt((0.25 + 0 + 1) / 3))
+
+
+def test_logistic_log_likelihood_clamps():
+    # exact 0/1 predictions must not produce -inf (reference epsilon clamp)
+    ll = M.logistic_log_likelihood(np.asarray([0.0, 1.0]), np.asarray([0.0, 1.0]))
+    assert np.isfinite(ll)
+
+
+def test_poisson_log_likelihood():
+    margins = np.asarray([0.0, 1.0])
+    labels = np.asarray([1.0, 2.0])
+    expect = np.mean(labels * margins - np.exp(margins)
+                     - np.asarray([math.lgamma(2.0), math.lgamma(3.0)]))
+    assert M.poisson_log_likelihood(margins, labels) == pytest.approx(expect)
+
+
+def test_evaluate_glm_end_to_end(rng):
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.glm import model_for_task
+    import jax.numpy as jnp
+    x = rng.normal(size=(300, 5)); x[:, -1] = 1.0
+    w = rng.normal(size=5)
+    y = (rng.uniform(size=300) < 1 / (1 + np.exp(-(x @ w)))).astype(float)
+    model = model_for_task("logistic_regression", Coefficients(jnp.asarray(w)))
+    m = evaluate_glm(model, x, y)
+    assert m[M.AREA_UNDER_ROC] > 0.7
+    assert m[M.DATA_LOG_LIKELIHOOD] < 0
+
+
+# -- bootstrap ----------------------------------------------------------------
+
+def test_bootstrap_cis_cover_truth(rng):
+    n, d = 600, 4
+    x = rng.normal(size=(n, d)); x[:, -1] = 1.0
+    w_true = np.asarray([1.0, -2.0, 0.0, 0.5])
+    y = x @ w_true + 0.1 * rng.normal(size=n)
+    rep = bootstrap_training(x, y, "linear_regression",
+                             num_bootstrap_samples=8, training_portion=0.75,
+                             regularization=L2, regularization_weight=1e-6,
+                             seed=5)
+    assert len(rep.coefficient_summaries) == d
+    for j, s in enumerate(rep.coefficient_summaries):
+        assert s.min <= w_true[j] + 0.15 and s.max >= w_true[j] - 0.15
+    # strong coefficients are significant; the zero one is near zero (its
+    # IQR may still exclude 0 — replicas share 75% of rows, so estimates of
+    # a tiny OLS artifact are tightly correlated)
+    assert rep.significant_mask[0] and rep.significant_mask[1]
+    assert abs(rep.coefficient_summaries[2].median) < 0.05
+    assert M.ROOT_MEAN_SQUARE_ERROR in rep.metric_summaries
+    assert rep.metric_summaries[M.ROOT_MEAN_SQUARE_ERROR].median < 0.2
+
+
+def test_bootstrap_validates_args(rng):
+    x, y = rng.normal(size=(20, 2)), rng.normal(size=20)
+    with pytest.raises(ValueError):
+        bootstrap_training(x, y, "linear_regression", num_bootstrap_samples=1)
+    with pytest.raises(ValueError):
+        bootstrap_training(x, y, "linear_regression", training_portion=1.5)
+
+
+# -- Hosmer-Lemeshow ----------------------------------------------------------
+
+def test_hl_well_calibrated_vs_miscalibrated(rng):
+    n = 4000
+    p = rng.uniform(0.05, 0.95, size=n)
+    y_good = (rng.uniform(size=n) < p).astype(float)
+    good = hosmer_lemeshow(p, y_good, num_dimensions=8)
+    # miscalibrated: probabilities systematically overconfident
+    p_bad = np.clip(p ** 3, 0.01, 0.99)
+    bad = hosmer_lemeshow(p_bad, y_good, num_dimensions=8)
+    assert good.chi_squared < bad.chi_squared
+    assert good.prob_at_chi_square < 0.99
+    assert bad.prob_at_chi_square > 0.999
+    assert bad.degrees_of_freedom == len(bad.bins) - 2
+    assert len(good.cutoffs) == 15
+
+
+# -- Kendall tau --------------------------------------------------------------
+
+def test_kendall_tau_dependent_vs_independent(rng):
+    n = 300
+    a = rng.normal(size=n)
+    dep = kendall_tau_analysis(a, a + 0.1 * rng.normal(size=n))
+    ind = kendall_tau_analysis(a, rng.normal(size=n))
+    assert dep.tau_alpha > 0.8
+    assert abs(ind.tau_alpha) < 0.1
+    assert dep.p_value > 0.99       # two-sided mass inside |z|: dependence
+    assert ind.p_value < dep.p_value
+    assert dep.num_concordant + dep.num_discordant == dep.effective_pairs
+
+
+def test_kendall_tau_perfect_and_ties():
+    a = np.asarray([1.0, 2.0, 3.0, 4.0])
+    r = kendall_tau_analysis(a, a * 2)
+    assert r.tau_alpha == pytest.approx(1.0)
+    rt = kendall_tau_analysis(np.asarray([1.0, 1.0, 2.0]),
+                              np.asarray([1.0, 2.0, 3.0]))
+    assert "ties" in rt.message
+
+
+# -- feature importance -------------------------------------------------------
+
+def test_feature_importance_rankings(rng):
+    from photon_ml_tpu.data.stats import BasicStatisticalSummary
+    x = rng.normal(size=(100, 3)) * np.asarray([1.0, 10.0, 0.1])
+    summary = BasicStatisticalSummary.from_features(x)
+    c = np.asarray([1.0, 1.0, 1.0])
+    rep = feature_importance(c, summary, ["a", "b", "c"], "expected_magnitude")
+    assert rep.ranked[0][0] == "b"          # largest scale dominates
+    assert rep.ranked[-1][0] == "c"
+    rep_v = feature_importance(c, summary, ["a", "b", "c"], "variance")
+    assert rep_v.ranked[0][0] == "b"
+    no_sum = feature_importance(np.asarray([3.0, 1.0]), None, None)
+    assert no_sum.ranked[0][1] == 0          # falls back to |c|
+
+
+# -- fitting ------------------------------------------------------------------
+
+def test_fitting_curves_improve_with_data(rng):
+    n, d = 2000, 4
+    x = rng.normal(size=(n, d)); x[:, -1] = 1.0
+    y = x @ rng.normal(size=d) + 0.2 * rng.normal(size=n)
+    rep = fitting_diagnostic(x, y, "linear_regression",
+                             regularization=L2, regularization_weight=1e-6,
+                             seed=3)
+    assert M.ROOT_MEAN_SQUARE_ERROR in rep.metrics
+    curve = rep.metrics[M.ROOT_MEAN_SQUARE_ERROR]
+    assert len(curve["portions"]) == 9
+    assert curve["portions"] == sorted(curve["portions"])
+    # holdout error with 9x data <= error with 1x data (allow noise wiggle)
+    assert curve["test"][-1] <= curve["test"][0] * 1.05
+
+
+def test_fitting_requires_enough_data(rng):
+    rep = fitting_diagnostic(rng.normal(size=(30, 5)), rng.normal(size=30),
+                             "linear_regression")
+    assert rep.metrics == {} and "not enough data" in rep.message
+
+
+# -- report + CLI -------------------------------------------------------------
+
+def test_report_rendering(rng):
+    metrics = {"Area under ROC": 0.91, "Peak F1 score": 0.8}
+    rep = DiagnosticReport("logistic_regression", metrics)
+    md = render_markdown(rep)
+    assert "# Model diagnostic report" in md and "0.91" in md
+    d = rep.to_dict()
+    json.dumps(d)  # serializable
+
+
+def test_diagnose_cli_end_to_end(rng, tmp_path):
+    import jax.numpy as jnp
+    from photon_ml_tpu.data import build_game_dataset
+    from photon_ml_tpu.data.game_data import save_game_dataset
+    from photon_ml_tpu.game import (
+        FixedEffectCoordinateConfig, GameEstimator, GameTrainingConfig,
+        GLMOptimizationConfig,
+    )
+    from photon_ml_tpu.models.io import save_game_model
+    from photon_ml_tpu.cli.diagnose import main
+
+    n, d = 900, 5
+    x = rng.normal(size=(n, d)); x[:, -1] = 1.0
+    w = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w)))).astype(float)
+    ds = build_game_dataset(y, {"global": x})
+    save_game_dataset(ds, str(tmp_path / "data.npz"))
+
+    cfg = GameTrainingConfig(
+        "logistic_regression",
+        {"fixed": FixedEffectCoordinateConfig(
+            "global", GLMOptimizationConfig(regularization=L2,
+                                            regularization_weight=0.01))},
+        ["fixed"])
+    res = GameEstimator(cfg).fit(ds)
+    save_game_model(res.model, str(tmp_path / "model"), config=cfg)
+
+    rc = main(["--model-dir", str(tmp_path / "model"),
+               "--data", str(tmp_path / "data.npz"),
+               "--output-dir", str(tmp_path / "diag"),
+               "--bootstrap-samples", "4"])
+    assert rc == 0
+    report = json.loads((tmp_path / "diag" / "report.json").read_text())
+    assert report["task_type"] == "logistic_regression"
+    assert report["metrics"]["Area under ROC"] > 0.7
+    assert "hosmer_lemeshow" in report
+    assert "bootstrap" in report
+    assert "fitting" in report
+    md = (tmp_path / "diag" / "report.md").read_text()
+    assert "Hosmer-Lemeshow" in md and "Learning curves" in md
