@@ -60,7 +60,7 @@ class BootstrapReport:
     coefficient_summaries: List[CoefficientSummary]
     # metric name -> summary over replicas (holdout evaluation)
     metric_summaries: Dict[str, CoefficientSummary]
-    # fraction of replicas where the coefficient's IQR excludes zero
+    # per-coefficient: True when the cross-replica IQR excludes zero
     significant_mask: np.ndarray
 
     def to_dict(self) -> dict:

@@ -3,7 +3,10 @@
 Rebuild of photon-diagnostics/.../diagnostics/hl/*:
   - bin count heuristic: min(dim + 2, 0.9*sqrt(n) + 0.9*log1p(n))
     (DefaultPredictedProbabilityVersusObservedFrequencyBinner.scala — the
-    reference uses DATA_HEURISTIC_FACTOR_A for BOTH terms, reproduced here)
+    reference uses DATA_HEURISTIC_FACTOR_A for BOTH terms, reproduced here).
+    Deliberate divergence: we floor the count at 3 so chi^2 keeps >= 1 degree
+    of freedom on tiny/low-dim inputs; the reference takes the plain min and
+    can produce a degenerate (< 3 bin) test there.
   - equal-width predicted-probability bins; per bin chi^2 contribution
     (obs-exp)^2/exp for positives and negatives, skipped when exp == 0, with
     a warning when expected < 5 (HosmerLemeshowDiagnostic.scala:25-120)

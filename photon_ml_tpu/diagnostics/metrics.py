@@ -60,13 +60,14 @@ def _degenerate(labels: np.ndarray) -> bool:
 
 
 def area_under_pr(predictions: np.ndarray, labels: np.ndarray) -> float:
-    """Trapezoid over the PR curve with the (0, 1) start point spark-mllib
-    prepends."""
+    """Trapezoid over the PR curve with the (0, firstPrecision) start point
+    spark-mllib prepends (not (0, 1): when the top-scoring tie group contains
+    negatives, precision[0] < 1 and starting at 1 would inflate the area)."""
     if _degenerate(labels):
         return float("nan")
     recall, precision, _, _ = _binary_curves(predictions, labels)
     r = np.concatenate([[0.0], recall])
-    p = np.concatenate([[1.0], precision])
+    p = np.concatenate([[precision[0]], precision])
     return float(np.trapezoid(p, r))
 
 

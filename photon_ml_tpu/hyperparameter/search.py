@@ -14,6 +14,7 @@ while the problem is underdetermined (GaussianProcessSearch.scala:76-110).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Callable, Generic, List, Optional, Sequence, Tuple, TypeVar
 
@@ -106,6 +107,15 @@ class RandomSearch(Generic[T]):
         converted = [(self.evaluation_function.vectorize_params(o),
                       self.evaluation_function.get_evaluation_value(o))
                      for o in observations]
+        # drop priors outside the search box (e.g. a grid result with
+        # regularization_weight=0 vectorizes to log10(1e-12) = -12, far
+        # outside the default [-3,3] range and would skew the GP)
+        kept = [(c, v) for c, v in converted if self._in_box(c)]
+        if len(kept) < len(converted):
+            logging.getLogger(__name__).warning(
+                "dropped %d of %d prior observations outside the search box %s",
+                len(converted) - len(kept), len(converted), self.ranges)
+        converted = kept
         for cand, value in converted[:-1]:
             self._on_observation(cand, value)
         last: Optional[Tuple[np.ndarray, float]] = (
@@ -121,6 +131,10 @@ class RandomSearch(Generic[T]):
             results.append(payload)
             last = (np.asarray(candidate, dtype=np.float64), value)
         return results
+
+    def _in_box(self, point: np.ndarray) -> bool:
+        return all(lo <= v <= hi
+                   for v, (lo, hi) in zip(np.ravel(point), self.ranges))
 
     # -- template methods (overridden by GaussianProcessSearch) ---------------
     def next(self, last_candidate: np.ndarray, last_value: float) -> np.ndarray:

@@ -37,11 +37,16 @@ class SliceSampler:
             x = self._draw_along(x, int(i))
         return x
 
-    def _draw_along(self, x: np.ndarray, i: int) -> np.ndarray:
+    def _draw_along(self, x: np.ndarray, i: int, max_rejections: int = 1000
+                    ) -> np.ndarray:
         y = math.log(self.rng.random()) + float(self.logp(x))
         lower, upper = self._step_out(x, y, i)
         lo_bound, hi_bound = self.range
-        while True:
+        # bounded: if logp is -inf over the whole range (e.g. every Cholesky
+        # fails because two observation vectors are duplicated), no candidate
+        # ever satisfies logp > y = -inf — return x unchanged instead of
+        # spinning forever
+        for _ in range(max_rejections):
             xi = lower + self.rng.random() * (upper - lower)
             new_x = x.copy()
             new_x[i] = xi
@@ -55,6 +60,7 @@ class SliceSampler:
                 upper = xi
             else:
                 lower, upper = lo_bound, hi_bound
+        return x
 
     def _step_out(self, x: np.ndarray, y: float, i: int) -> Tuple[float, float]:
         lo_bound, hi_bound = self.range
