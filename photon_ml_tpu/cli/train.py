@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'auto' = all local devices on the data axis, 'none' "
                         "= single device, or 'DxF' (e.g. '4x2' = 4-way data "
                         "x 2-way feature sharding)")
+    p.add_argument("--data-validation", default="full",
+                   choices=["full", "sample", "disabled"],
+                   help="input sanity-check intensity (reference: "
+                        "DataValidationType VALIDATE_FULL/SAMPLE/DISABLED)")
     # hyperparameter tuning (reference: GameTrainingParams tuning mode +
     # Driver.runHyperparameterTuning, cli/game/training/Driver.scala:337-373)
     p.add_argument("--tuning", default="none",
@@ -66,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tuning-iterations", type=int, default=10)
     p.add_argument("--tuning-range", default="-3,3",
                    help="log10 lambda search range 'lo,hi' per coordinate")
+    p.add_argument("--warm-start", action="store_true",
+                   help="initialize each grid combo / tuning refit from the "
+                        "previous (best) model (reference: use-warm-start, "
+                        "GameTrainingParams.scala:197)")
+    p.add_argument("--event-listener", action="append", default=[],
+                   help="dotted class path of an EventListener to register "
+                        "(repeatable; reference: Driver.scala:108-118)")
     return p
 
 
@@ -118,78 +129,103 @@ def main(argv=None) -> int:
           f"{ {s: x.shape[1] for s, x in train.feature_shards.items()} }",
           file=sys.stderr)
 
+    # reference: Driver.run -> DataValidators.sanityCheckDataFrameForTraining
+    from photon_ml_tpu.data.validators import validate_game_dataset
+    validate_game_dataset(train, args.task, args.data_validation)
+    if val is not None:
+        validate_game_dataset(val, args.task, args.data_validation)
+
     mesh = make_mesh_from_arg(args.mesh)
     if mesh is not None:
         print(f"mesh: {dict(mesh.shape)} over {len(mesh.devices.ravel())} "
               f"devices", file=sys.stderr)
     evaluator_specs = args.evaluators.split(",") if args.evaluators else None
 
-    if args.config:
-        with open(args.config) as f:
-            config = GameTrainingConfig.from_json(f.read())
-        results = [GameEstimator(config, mesh=mesh).fit(
-            train, val, evaluator_specs)]
-    else:
-        # legacy single-GLM path: one FE coordinate, lambda sweep, best by
-        # first validation evaluator (reference: Driver stage machine +
-        # ModelSelection)
-        reg = RegularizationContext(RegularizationType(args.regularization),
-                                    args.elastic_net_alpha)
-        opt = OptimizerConfig(optimizer=OptimizerType(args.optimizer),
-                              max_iterations=args.max_iterations,
-                              tolerance=args.tolerance)
-        weights = [float(w) for w in args.reg_weights.split(",")]
-        grid = {"fixed": [GLMOptimizationConfig(optimizer=opt, regularization=reg,
-                                                regularization_weight=w)
-                          for w in sorted(weights, reverse=True)]}
-        config = GameTrainingConfig(
-            task_type=args.task,
-            coordinates={"fixed": FixedEffectCoordinateConfig(
-                "global", GLMOptimizationConfig(optimizer=opt, regularization=reg),
-                normalization=NormalizationType(args.normalization))},
-            updating_sequence=["fixed"])
-        results = GameEstimator(config, mesh=mesh).fit_grid(
-            train, grid, val, evaluator_specs)
+    # event hooks (reference: Driver.scala:108-118 registers listeners by
+    # class name; PhotonSetupEvent carries the run params)
+    from photon_ml_tpu.utils.events import EventEmitter, SetupEvent
+    emitter = EventEmitter() if args.event_listener else None
+    if emitter is not None:
+        for dotted in args.event_listener:
+            emitter.register_listener_class(dotted)
+        emitter.send_event(SetupEvent(params=vars(args)))
 
-    if args.tuning != "none":
-        # reference: Driver.runHyperparameterTuning — searcher seeded with
-        # the grid results, evaluation = refit with the candidate lambdas
-        if val is None:
-            raise SystemExit("--tuning requires --validation-data")
-        from photon_ml_tpu.hyperparameter import (
-            GameEstimatorEvaluationFunction, GaussianProcessSearch, RandomSearch)
-        fn = GameEstimatorEvaluationFunction(
-            GameEstimator(config, mesh=mesh), train, val, evaluator_specs,
-            scale="log")
-        lo, hi = (float(v) for v in args.tuning_range.split(","))
-        ranges = [(lo, hi)] * fn.num_params
-        spec0 = results[0].validation_specs[0]
-        if args.tuning == "bayesian":
-            search = GaussianProcessSearch(ranges, fn, spec0.evaluator,
-                                           seed=config.seed)
+    try:
+        if args.config:
+            with open(args.config) as f:
+                config = GameTrainingConfig.from_json(f.read())
+            results = [GameEstimator(config, mesh=mesh, emitter=emitter).fit(
+                train, val, evaluator_specs)]
         else:
-            search = RandomSearch(ranges, fn, seed=config.seed)
-        prior = [r for r in results if r.validation]
-        results = results + search.find(args.tuning_iterations, prior)
+            # legacy single-GLM path: one FE coordinate, lambda sweep, best by
+            # first validation evaluator (reference: Driver stage machine +
+            # ModelSelection)
+            reg = RegularizationContext(RegularizationType(args.regularization),
+                                        args.elastic_net_alpha)
+            opt = OptimizerConfig(optimizer=OptimizerType(args.optimizer),
+                                  max_iterations=args.max_iterations,
+                                  tolerance=args.tolerance)
+            weights = [float(w) for w in args.reg_weights.split(",")]
+            grid = {"fixed": [GLMOptimizationConfig(optimizer=opt, regularization=reg,
+                                                    regularization_weight=w)
+                              for w in sorted(weights, reverse=True)]}
+            config = GameTrainingConfig(
+                task_type=args.task,
+                coordinates={"fixed": FixedEffectCoordinateConfig(
+                    "global", GLMOptimizationConfig(optimizer=opt, regularization=reg),
+                    normalization=NormalizationType(args.normalization))},
+                updating_sequence=["fixed"])
+            results = GameEstimator(config, mesh=mesh, emitter=emitter).fit_grid(
+                train, grid, val, evaluator_specs, warm_start=args.warm_start)
 
-    from photon_ml_tpu.game.estimator import select_best_result
-    best = select_best_result(results)
-    os.makedirs(args.output_dir, exist_ok=True)
-    save_game_model(best.model, os.path.join(args.output_dir, "best"),
-                    config=best.config, index_maps=train.index_maps or None)
-    summary = {
-        "task": args.task,
-        "train_rows": train.num_rows,
-        "num_configs": len(results),
-        "final_objective": best.objective_history[-1],
-        "validation": best.validation,
-        "wall_s": round(time.time() - t0, 2),
-        "output": os.path.join(args.output_dir, "best"),
-    }
-    with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
-    print(json.dumps(summary))
-    return 0
+        if args.tuning != "none":
+            # reference: Driver.runHyperparameterTuning — searcher seeded with
+            # the grid results, evaluation = refit with the candidate lambdas
+            if val is None:
+                raise SystemExit("--tuning requires --validation-data")
+            from photon_ml_tpu.hyperparameter import (
+                GameEstimatorEvaluationFunction, GaussianProcessSearch, RandomSearch)
+            fn = GameEstimatorEvaluationFunction(
+                GameEstimator(config, mesh=mesh), train, val, evaluator_specs,
+                scale="log", warm_start=args.warm_start)
+            if args.warm_start:
+                for r in results:
+                    if r.validation:
+                        fn.observe(r)
+            lo, hi = (float(v) for v in args.tuning_range.split(","))
+            ranges = [(lo, hi)] * fn.num_params
+            spec0 = results[0].validation_specs[0]
+            if args.tuning == "bayesian":
+                search = GaussianProcessSearch(ranges, fn, spec0.evaluator,
+                                               seed=config.seed)
+            else:
+                search = RandomSearch(ranges, fn, seed=config.seed)
+            prior = [r for r in results if r.validation]
+            results = results + search.find(args.tuning_iterations, prior)
+
+        from photon_ml_tpu.game.estimator import select_best_result
+        best = select_best_result(results)
+        os.makedirs(args.output_dir, exist_ok=True)
+        save_game_model(best.model, os.path.join(args.output_dir, "best"),
+                        config=best.config, index_maps=train.index_maps or None)
+        summary = {
+            "task": args.task,
+            "train_rows": train.num_rows,
+            "num_configs": len(results),
+            "final_objective": best.objective_history[-1],
+            "validation": best.validation,
+            "wall_s": round(time.time() - t0, 2),
+            "output": os.path.join(args.output_dir, "best"),
+        }
+        with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        print(json.dumps(summary))
+        return 0
+    finally:
+        # listeners flush buffered events in close() — run even when
+        # training/validation/tuning raises
+        if emitter is not None:
+            emitter.clear_listeners()
 
 
 if __name__ == "__main__":

@@ -14,3 +14,6 @@ from photon_ml_tpu.data.samplers import (  # noqa: F401
     binary_classification_downsample, default_downsample, downsampler_for_task,
 )
 from photon_ml_tpu.data.stats import BasicStatisticalSummary  # noqa: F401
+from photon_ml_tpu.data.validators import (  # noqa: F401
+    DataValidationError, DataValidationType, validate_game_dataset,
+)

@@ -56,12 +56,38 @@ class ValidationSpec:
 
 
 @dataclasses.dataclass
+class TrackerSummary:
+    """Host-side per-solve record (reference: OptimizationStatesTracker
+    records per-iteration state + wall clock, OptimizationStatesTracker
+    .scala:32-102; here iterations are summed over vmapped entities)."""
+
+    iterations: int
+    wall_s: float
+
+
+def _summarize_tracker(tracker: object, wall_s: float) -> TrackerSummary:
+    it = getattr(tracker, "iterations", None)
+    count = 0 if it is None else int(np.sum(np.asarray(it)))
+    return TrackerSummary(iterations=count, wall_s=wall_s)
+
+
+@dataclasses.dataclass
 class CoordinateDescentResult:
     model: GameModel                       # final full model
     best_model: GameModel                  # best by first validation evaluator
     objective_history: List[float]         # after each coordinate update
     validation_history: Dict[str, List[float]]
-    timings: Dict[str, float]
+    timings: Dict[str, float]              # "it/coord" -> solve wall clock
+    # "it/coord" -> compact host-side solve summary (iterations, wall clock);
+    # a full SolveResult per solve would pin [E, d]-sized device arrays for
+    # the lifetime of every GameResult in a sweep
+    # (reference: OptimizationStatesTracker per update)
+    trackers: Dict[str, "TrackerSummary"] = dataclasses.field(default_factory=dict)
+
+    def total_iterations(self) -> int:
+        """Sum of inner optimizer iterations across all solves (vmapped RE
+        trackers contribute their per-entity counts)."""
+        return sum(t.iterations for t in self.trackers.values())
 
 
 def run_coordinate_descent(
@@ -99,6 +125,7 @@ def run_coordinate_descent(
     objective_history: List[float] = []
     validation_history: Dict[str, List[float]] = {s.name: [] for s in validation_specs}
     timings: Dict[str, float] = {}
+    trackers: Dict[str, TrackerSummary] = {}
     best_model = GameModel(dict(models), task_type)
     best_metric: Optional[float] = None
 
@@ -121,6 +148,8 @@ def run_coordinate_descent(
             scores[name] = coord.score(models[name])
             total = partial + scores[name]
             timings[f"{it}/{name}"] = time.perf_counter() - t0
+            trackers[f"{it}/{name}"] = _summarize_tracker(
+                tracker, timings[f"{it}/{name}"])
 
             obj = training_objective(total, models)
             objective_history.append(obj)
@@ -146,4 +175,5 @@ def run_coordinate_descent(
     return CoordinateDescentResult(
         model=final, best_model=best_model,
         objective_history=objective_history,
-        validation_history=validation_history, timings=timings)
+        validation_history=validation_history, timings=timings,
+        trackers=trackers)

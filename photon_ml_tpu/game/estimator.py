@@ -11,9 +11,15 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from jax.sharding import Mesh
+
+from photon_ml_tpu.utils.events import (
+    EventEmitter, OptimizationLogEvent, TrainingFinishEvent,
+    TrainingStartEvent,
+)
 
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.evaluation.evaluators import (
@@ -47,9 +53,11 @@ class GameResult:
 
 
 class GameEstimator:
-    def __init__(self, config: GameTrainingConfig, mesh: Optional[Mesh] = None):
+    def __init__(self, config: GameTrainingConfig, mesh: Optional[Mesh] = None,
+                 emitter: Optional[EventEmitter] = None):
         self.config = config
         self.mesh = mesh
+        self.emitter = emitter
 
     def _build_coordinates(self, dataset: GameDataset) -> Dict[str, Coordinate]:
         coords: Dict[str, Coordinate] = {}
@@ -85,17 +93,35 @@ class GameEstimator:
         dataset: GameDataset,
         validation_dataset: Optional[GameDataset] = None,
         evaluator_specs: Optional[Sequence[str]] = None,
+        initial_model: Optional[GameModel] = None,
     ) -> GameResult:
-        """reference: GameEstimator.fit (GameEstimator.scala:175)."""
+        """reference: GameEstimator.fit (GameEstimator.scala:175).
+
+        `initial_model` warm-starts every coordinate it covers (reference:
+        GameTrainingParams.useWarmStart — "the previous optimal model is used
+        to initialize the next model")."""
+        if self.emitter is not None:
+            self.emitter.send_event(TrainingStartEvent(time.time()))
         coords = self._build_coordinates(dataset)
         specs = (self._validation_specs(evaluator_specs)
                  if validation_dataset is not None else [])
+        initial_models = (dict(initial_model.coordinates)
+                          if initial_model is not None else None)
         descent = run_coordinate_descent(
             coords, self.config.updating_sequence,
             self.config.num_outer_iterations, dataset, self.config.task_type,
-            validation_dataset=validation_dataset, validation_specs=specs)
+            validation_dataset=validation_dataset, validation_specs=specs,
+            initial_models=initial_models)
         validation = {name: hist[-1] for name, hist in
                       descent.validation_history.items() if hist}
+        if self.emitter is not None:
+            self.emitter.send_event(OptimizationLogEvent(
+                regularization_weights={
+                    n: c.optimization.regularization_weight
+                    for n, c in self.config.coordinates.items()},
+                objective_history=list(descent.objective_history),
+                final_metrics=dict(validation)))
+            self.emitter.send_event(TrainingFinishEvent(time.time()))
         return GameResult(model=descent.best_model, config=self.config,
                           objective_history=descent.objective_history,
                           validation=validation, descent=descent,
@@ -107,19 +133,29 @@ class GameEstimator:
         grid: Dict[str, Sequence[GLMOptimizationConfig]],
         validation_dataset: Optional[GameDataset] = None,
         evaluator_specs: Optional[Sequence[str]] = None,
+        warm_start: bool = False,
     ) -> List[GameResult]:
         """Sweep per-coordinate optimization configs (cartesian product),
         reference: GameTrainingParams.getAllModelConfigs + train-per-config
-        (GameEstimator.scala:474)."""
+        (GameEstimator.scala:474).
+
+        With `warm_start`, each combo is initialized from the previous
+        combo's trained model (reference: useWarmStart; ModelTraining.scala:
+        160-196 does the same across the lambda sweep — pass the grid
+        strongest-regularization-first to match)."""
         names = list(grid)
-        results = []
+        results: List[GameResult] = []
+        previous: Optional[GameModel] = None
         for combo in itertools.product(*(grid[n] for n in names)):
             coords = dict(self.config.coordinates)
             for n, opt in zip(names, combo):
                 coords[n] = dataclasses.replace(coords[n], optimization=opt)
             cfg = dataclasses.replace(self.config, coordinates=coords)
-            results.append(GameEstimator(cfg, self.mesh).fit(
-                dataset, validation_dataset, evaluator_specs))
+            sub = GameEstimator(cfg, self.mesh, emitter=self.emitter)
+            results.append(sub.fit(
+                dataset, validation_dataset, evaluator_specs,
+                initial_model=previous if warm_start else None))
+            previous = results[-1].model
         return results
 
 

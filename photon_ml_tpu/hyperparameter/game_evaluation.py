@@ -34,6 +34,7 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameResult]):
         validation_data: GameDataset,
         evaluator_specs: Optional[Sequence[str]] = None,
         scale: str = "log",
+        warm_start: bool = False,
     ):
         if scale not in ("log", "linear"):
             raise ValueError(f"scale must be 'log' or 'linear', got {scale!r}")
@@ -42,6 +43,10 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameResult]):
         self.validation_data = validation_data
         self.evaluator_specs = evaluator_specs
         self.scale = scale
+        # warm start: each tuning refit initializes from the best model seen
+        # so far (reference: GameTrainingParams.useWarmStart)
+        self.warm_start = warm_start
+        self._best_result: Optional[GameResult] = None
         # sorted for a consistent vector layout (reference uses SortedMap)
         self.coordinate_names = sorted(estimator.config.coordinates)
 
@@ -91,9 +96,20 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameResult]):
 
     def __call__(self, candidate: np.ndarray) -> Tuple[float, GameResult]:
         config = self._vector_to_config(candidate)
+        initial = (self._best_result.model
+                   if self.warm_start and self._best_result is not None else None)
         result = GameEstimator(config, self.estimator.mesh).fit(
-            self.data, self.validation_data, self.evaluator_specs)
+            self.data, self.validation_data, self.evaluator_specs,
+            initial_model=initial)
+        self.observe(result)
         return self.get_evaluation_value(result), result
+
+    def observe(self, result: GameResult) -> None:
+        """Feed a prior (e.g. grid) result into the warm-start pool."""
+        if self._best_result is None or result.validation_specs[0].evaluator.better_than(
+                self.get_evaluation_value(result),
+                self.get_evaluation_value(self._best_result)):
+            self._best_result = result
 
     def vectorize_params(self, observation: GameResult) -> np.ndarray:
         return self._config_to_vector(observation.config)

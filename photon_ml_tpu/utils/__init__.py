@@ -1,1 +1,5 @@
 from photon_ml_tpu.utils.math import EPSILON, is_almost_zero, log1p_exp, safe_div  # noqa: F401
+from photon_ml_tpu.utils.events import (  # noqa: F401
+    Event, EventEmitter, EventListener, LoggingEventListener,
+    OptimizationLogEvent, SetupEvent, TrainingFinishEvent, TrainingStartEvent,
+)

@@ -1,0 +1,108 @@
+"""Observer-style event hooks for external training consumers.
+
+Rebuild of photon-client/.../event/{Event,EventEmitter,EventListener}.scala:
+typed events (setup, training start/finish, per-model optimization log —
+Event.scala:36-60) fanned out to registered listeners; listener exceptions
+are swallowed so a broken consumer can't kill training (EventEmitter
+sendEvent wraps each handle in Try).
+
+Listeners can be registered programmatically or by dotted class path (the
+reference registers listener class names from CLI flags, Driver.scala:
+108-118).
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Event:
+    """Base event (reference: Event.scala)."""
+
+
+@dataclasses.dataclass
+class SetupEvent(Event):
+    """reference: PhotonSetupEvent — carries the run configuration."""
+
+    params: Dict[str, Any]
+
+
+@dataclasses.dataclass
+class TrainingStartEvent(Event):
+    time: float
+
+
+@dataclasses.dataclass
+class TrainingFinishEvent(Event):
+    time: float
+
+
+@dataclasses.dataclass
+class OptimizationLogEvent(Event):
+    """reference: PhotonOptimizationLogEvent — per trained model: the
+    regularization weights used, convergence histories, and final metrics."""
+
+    regularization_weights: Dict[str, float]
+    objective_history: List[float]
+    final_metrics: Dict[str, float]
+
+
+class EventListener:
+    """reference: EventListener.scala — handle() + close()."""
+
+    def handle(self, event: Event) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LoggingEventListener(EventListener):
+    """Default listener: events into the standard logging stream."""
+
+    def handle(self, event: Event) -> None:
+        _log.info("%s", event)
+
+
+class EventEmitter:
+    """reference: EventEmitter.scala — thread-safe register/clear/send with
+    listener exceptions contained."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners: List[EventListener] = []
+
+    def register_listener(self, listener: EventListener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def register_listener_class(self, dotted_path: str) -> None:
+        """'pkg.module.ClassName' -> instantiate and register (reference:
+        Driver.scala:108-118 registering listeners by class name)."""
+        module_name, _, cls_name = dotted_path.rpartition(".")
+        cls = getattr(importlib.import_module(module_name), cls_name)
+        self.register_listener(cls())
+
+    def clear_listeners(self) -> None:
+        with self._lock:
+            for listener in self._listeners:
+                try:
+                    listener.close()
+                except Exception:
+                    _log.exception("event listener close failed")
+            self._listeners = []
+
+    def send_event(self, event: Event) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
+            try:
+                listener.handle(event)
+            except Exception:
+                _log.exception("event listener failed on %s", type(event).__name__)

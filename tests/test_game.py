@@ -130,6 +130,38 @@ def test_grid_fit_and_selection(rng):
     assert best.validation["RMSE"] == min(r.validation["RMSE"] for r in results)
 
 
+def test_grid_warm_start_fewer_iterations_same_loss(rng):
+    """reference: use-warm-start (GameTrainingParams.scala:197) — each grid
+    combo initialized from the previous model must converge in fewer total
+    inner iterations to an equal final loss."""
+    ds, _ = _dataset(rng, n=600)
+    rows = np.arange(ds.num_rows)
+    train, val = ds.subset(rows[:450]), ds.subset(rows[450:])
+    # strongest-first lambda sweep on the FE coordinate (ModelTraining.scala
+    # sorts descending for exactly this reason)
+    grid = {"fixed": [
+        GLMOptimizationConfig(regularization=L2, regularization_weight=w)
+        for w in (10.0, 1.0, 0.1)]}
+    est = GameEstimator(_config(iters=1))
+    cold = est.fit_grid(train, grid, val, warm_start=False)
+    warm = est.fit_grid(train, grid, val, warm_start=True)
+    for c, w in zip(cold, warm):
+        assert w.objective_history[-1] <= c.objective_history[-1] * (1 + 1e-6)
+    cold_iters = sum(r.descent.total_iterations() for r in cold)
+    warm_iters = sum(r.descent.total_iterations() for r in warm)
+    assert warm_iters < cold_iters, (cold_iters, warm_iters)
+
+
+def test_fit_initial_model_warm_start_converges_immediately(rng):
+    ds, _ = _dataset(rng, n=600)
+    est = GameEstimator(_config(iters=1))
+    first = est.fit(ds)
+    again = est.fit(ds, initial_model=first.model)
+    # restarting from the solution: same objective, fewer iterations
+    assert again.objective_history[-1] <= first.objective_history[-1] * (1 + 1e-6)
+    assert again.descent.total_iterations() < first.descent.total_iterations()
+
+
 def test_unseen_validation_entities_score_zero_contribution(rng):
     ds, _ = _dataset(rng, n=400, num_users=10)
     res = GameEstimator(_config(iters=1)).fit(ds)

@@ -1,0 +1,178 @@
+"""DataValidators + event-system tests.
+
+reference: photon-client/.../data/DataValidators.scala:33-332 (per-task row
+checks with VALIDATE_FULL/SAMPLE/DISABLED gating) and
+event/{Event,EventEmitter,EventListener}.scala.
+"""
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_data import build_game_dataset
+from photon_ml_tpu.data.validators import (
+    DataValidationError, DataValidationType, validate_game_dataset,
+)
+from photon_ml_tpu.utils.events import (
+    EventEmitter, EventListener, LoggingEventListener, OptimizationLogEvent,
+    TrainingFinishEvent, TrainingStartEvent,
+)
+
+
+def _clean(n=20, d=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    y = (rng.uniform(size=n) < 0.5).astype(float)
+    return x, y
+
+
+class TestValidators:
+    def test_clean_data_passes_all_tasks(self):
+        x, y = _clean()
+        ds = build_game_dataset(y, {"global": x}, offsets=np.zeros(20),
+                                weights=np.ones(20))
+        for task in ("logistic_regression", "linear_regression",
+                     "smoothed_hinge_loss_linear_svm"):
+            validate_game_dataset(ds, task)
+        validate_game_dataset(
+            build_game_dataset(np.abs(y), {"global": x}), "poisson_regression")
+
+    def test_non_binary_label_logistic(self):
+        x, y = _clean()
+        y[7] = 2.0
+        ds = build_game_dataset(y, {"global": x})
+        with pytest.raises(DataValidationError, match="non-binary.*row 7"):
+            validate_game_dataset(ds, "logistic_regression")
+        # same labels are fine for linear regression
+        validate_game_dataset(ds, "linear_regression")
+
+    def test_non_finite_label_linear(self):
+        x, y = _clean()
+        y[3] = np.nan
+        ds = build_game_dataset(y, {"global": x})
+        with pytest.raises(DataValidationError, match="non-finite label.*row 3"):
+            validate_game_dataset(ds, "linear_regression")
+
+    def test_negative_label_poisson(self):
+        x, y = _clean()
+        y[11] = -1.0
+        ds = build_game_dataset(y, {"global": x})
+        with pytest.raises(DataValidationError, match="negative label.*row 11"):
+            validate_game_dataset(ds, "poisson_regression")
+
+    def test_non_finite_feature_names_row_and_column(self):
+        x, y = _clean()
+        x[5, 2] = np.inf
+        ds = build_game_dataset(y, {"global": x})
+        with pytest.raises(DataValidationError,
+                           match="non-finite feature.*row 5.*'global' column 2"):
+            validate_game_dataset(ds, "logistic_regression")
+
+    def test_non_finite_offset_and_weight(self):
+        x, y = _clean()
+        off = np.zeros(20)
+        off[2] = np.inf
+        ds = build_game_dataset(y, {"global": x}, offsets=off)
+        with pytest.raises(DataValidationError, match="non-finite offset.*row 2"):
+            validate_game_dataset(ds, "logistic_regression")
+        w = np.ones(20)
+        w[9] = np.nan
+        ds = build_game_dataset(y, {"global": x}, weights=w)
+        with pytest.raises(DataValidationError, match="non-finite weight.*row 9"):
+            validate_game_dataset(ds, "logistic_regression")
+
+    def test_multiple_errors_all_reported(self):
+        x, y = _clean()
+        y[0] = 3.0
+        x[1, 0] = np.nan
+        ds = build_game_dataset(y, {"global": x})
+        with pytest.raises(DataValidationError) as e:
+            validate_game_dataset(ds, "logistic_regression")
+        msg = str(e.value)
+        assert "non-binary" in msg and "non-finite feature" in msg
+
+    def test_disabled_skips_everything(self):
+        x, y = _clean()
+        y[:] = np.nan
+        x[:] = np.inf
+        ds = build_game_dataset(y, {"global": x})
+        validate_game_dataset(ds, "logistic_regression",
+                              DataValidationType.VALIDATE_DISABLED)
+        validate_game_dataset(ds, "logistic_regression", "disabled")
+
+    def test_sample_mode_catches_pervasive_corruption(self):
+        # reference: VALIDATE_SAMPLE checks a 10% sample — with every row bad
+        # it must still fail
+        x, y = _clean(n=500)
+        y[:] = np.nan
+        ds = build_game_dataset(y, {"global": x})
+        with pytest.raises(DataValidationError):
+            validate_game_dataset(ds, "linear_regression",
+                                  DataValidationType.VALIDATE_SAMPLE)
+
+
+class _Recorder(EventListener):
+    def __init__(self):
+        self.events = []
+        self.closed = False
+
+    def handle(self, event):
+        self.events.append(event)
+
+    def close(self):
+        self.closed = True
+
+
+class _Broken(EventListener):
+    def handle(self, event):
+        raise RuntimeError("boom")
+
+
+class TestEvents:
+    def test_emitter_fanout_and_close(self):
+        em = EventEmitter()
+        rec = _Recorder()
+        em.register_listener(rec)
+        em.send_event(TrainingStartEvent(1.0))
+        em.send_event(TrainingFinishEvent(2.0))
+        assert [type(e) for e in rec.events] == [TrainingStartEvent,
+                                                 TrainingFinishEvent]
+        em.clear_listeners()
+        assert rec.closed
+
+    def test_broken_listener_does_not_kill_training(self):
+        em = EventEmitter()
+        rec = _Recorder()
+        em.register_listener(_Broken())
+        em.register_listener(rec)
+        em.send_event(TrainingStartEvent(0.0))  # must not raise
+        assert len(rec.events) == 1
+
+    def test_register_by_class_path(self):
+        em = EventEmitter()
+        em.register_listener_class(
+            "photon_ml_tpu.utils.events.LoggingEventListener")
+        assert isinstance(em._listeners[0], LoggingEventListener)
+
+    def test_estimator_emits_optimization_log(self):
+        from photon_ml_tpu.game import GameEstimator, GameTrainingConfig
+        from photon_ml_tpu.game.config import (FixedEffectCoordinateConfig,
+                                               GLMOptimizationConfig)
+        rng = np.random.default_rng(1)
+        x, y = _clean(n=64, d=4, seed=1)
+        ds = build_game_dataset(y, {"global": x})
+        cfg = GameTrainingConfig(
+            task_type="logistic_regression",
+            coordinates={"fixed": FixedEffectCoordinateConfig(
+                "global", GLMOptimizationConfig(regularization_weight=1.0))},
+            updating_sequence=["fixed"], num_outer_iterations=1)
+        em = EventEmitter()
+        rec = _Recorder()
+        em.register_listener(rec)
+        GameEstimator(cfg, emitter=em).fit(ds, ds)
+        kinds = [type(e) for e in rec.events]
+        assert kinds[0] is TrainingStartEvent
+        assert OptimizationLogEvent in kinds
+        assert kinds[-1] is TrainingFinishEvent
+        log = next(e for e in rec.events if isinstance(e, OptimizationLogEvent))
+        assert log.regularization_weights == {"fixed": 1.0}
+        assert len(log.objective_history) == 1
+        assert log.final_metrics
