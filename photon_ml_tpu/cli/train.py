@@ -130,10 +130,16 @@ def main(argv=None) -> int:
           file=sys.stderr)
 
     # reference: Driver.run -> DataValidators.sanityCheckDataFrameForTraining
+    # (validate against the task actually trained: the config file's
+    # task_type wins over --task on the GAME path)
     from photon_ml_tpu.data.validators import validate_game_dataset
-    validate_game_dataset(train, args.task, args.data_validation)
+    task = args.task
+    if args.config:
+        with open(args.config) as f:
+            task = GameTrainingConfig.from_json(f.read()).task_type
+    validate_game_dataset(train, task, args.data_validation)
     if val is not None:
-        validate_game_dataset(val, args.task, args.data_validation)
+        validate_game_dataset(val, task, args.data_validation)
 
     mesh = make_mesh_from_arg(args.mesh)
     if mesh is not None:
@@ -186,8 +192,8 @@ def main(argv=None) -> int:
             from photon_ml_tpu.hyperparameter import (
                 GameEstimatorEvaluationFunction, GaussianProcessSearch, RandomSearch)
             fn = GameEstimatorEvaluationFunction(
-                GameEstimator(config, mesh=mesh), train, val, evaluator_specs,
-                scale="log", warm_start=args.warm_start)
+                GameEstimator(config, mesh=mesh, emitter=emitter), train, val,
+                evaluator_specs, scale="log", warm_start=args.warm_start)
             if args.warm_start:
                 for r in results:
                     if r.validation:

@@ -17,7 +17,7 @@ Grouped metrics use one lexicographic argsort + contiguous group slices.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence  # noqa: F401
 
 import numpy as np
 
@@ -100,12 +100,20 @@ class MultiEvaluator:
 
     reference: MultiEvaluator.scala:49-64 (groupByKey + LocalEvaluator per
     group + mean of finite values).  `group_index` is a canonical-order int
-    column (an entity_indices column of the GameDataset)."""
+    column (an entity_indices column of the GameDataset).
+
+    When `segmented` is set (every built-in metric), all groups are computed
+    in one vectorized pass (evaluation/segmented.py) — the reference's
+    task-per-group model becomes flat segment ops; `local` remains the
+    exact-match oracle and the fallback for custom metrics."""
 
     name: str
     local: Callable  # (scores, labels, weights) -> float
     larger_is_better: bool
     min_group_size: int = 1
+    # (bounds, scores, labels, weights) -> per-group value array, inputs
+    # group-sorted with bounds[i]:bounds[i+1] slicing group i
+    segmented: Optional[Callable] = None
 
     def evaluate_grouped(self, group_index, scores, labels, weights=None) -> float:
         g = np.asarray(group_index)
@@ -116,14 +124,14 @@ class MultiEvaluator:
         gv, sv, yv = g[valid][order], s[valid][order], y[valid][order]
         wv = None if w is None else w[valid][order]
         bounds = np.concatenate([[0], np.nonzero(np.diff(gv))[0] + 1, [len(gv)]])
-        vals = []
-        for a, b in zip(bounds[:-1], bounds[1:]):
-            if b - a < self.min_group_size:
-                continue
-            v = self.local(sv[a:b], yv[a:b], None if wv is None else wv[a:b])
-            if np.isfinite(v):
-                vals.append(v)
-        return float(np.mean(vals)) if vals else float("nan")
+        if self.segmented is not None:
+            vals = np.asarray(self.segmented(bounds, sv, yv, wv))
+        else:
+            vals = np.asarray([
+                self.local(sv[a:b], yv[a:b], None if wv is None else wv[a:b])
+                for a, b in zip(bounds[:-1], bounds[1:])])
+        keep = np.isfinite(vals) & (np.diff(bounds) >= self.min_group_size)
+        return float(np.mean(vals[keep])) if keep.any() else float("nan")
 
     def better_than(self, a: float, b: float) -> bool:
         if np.isnan(a):
@@ -143,6 +151,21 @@ SMOOTHED_HINGE_LOSS = Evaluator("SMOOTHED_HINGE_LOSS", _loss_metric(L.SMOOTHED_H
 
 _BY_NAME = {e.name: e for e in (AUC, RMSE, LOGISTIC_LOSS, SQUARED_LOSS,
                                 POISSON_LOSS, SMOOTHED_HINGE_LOSS)}
+
+
+def _segmented_table():
+    from photon_ml_tpu.evaluation import segmented as seg
+    table = {"AUC": seg.grouped_auc, "RMSE": seg.grouped_rmse}
+    for name, loss in (("LOGISTIC_LOSS", L.LOGISTIC),
+                       ("SQUARED_LOSS", L.SQUARED),
+                       ("POISSON_LOSS", L.POISSON),
+                       ("SMOOTHED_HINGE_LOSS", L.SMOOTHED_HINGE)):
+        table[name] = (lambda b, s, y, w, _l=loss:
+                       seg.grouped_mean_loss(_l, b, s, y, w))
+    return table
+
+
+_SEGMENTED = _segmented_table()
 
 
 def default_evaluator_for_task(task_type: str) -> Evaluator:
@@ -171,19 +194,24 @@ def parse_evaluator(spec: str):
 
     reference: EvaluatorType / MultiEvaluatorType string parsing
     (MultiEvaluatorType.scala:60, e.g. PRECISION@K:10:queryId)."""
+    from photon_ml_tpu.evaluation import segmented as seg
     parts = spec.split(":")
     head = parts[0].upper()
     if head == "PRECISION@K":
         if len(parts) != 3:
             raise ValueError(f"PRECISION@K needs k and group column: {spec!r}")
         k = int(parts[1])
-        return MultiEvaluator(f"PRECISION@{k}:{parts[2]}",
-                              lambda s, y, w, _k=k: precision_at_k(_k, s, y, w),
-                              larger_is_better=True), parts[2]
+        return MultiEvaluator(
+            f"PRECISION@{k}:{parts[2]}",
+            lambda s, y, w, _k=k: precision_at_k(_k, s, y, w),
+            larger_is_better=True,
+            segmented=lambda b, s, y, w, _k=k: seg.grouped_precision_at_k(
+                _k, b, s, y, w)), parts[2]
     if len(parts) == 2:
         base = _BY_NAME[head]
         return MultiEvaluator(f"{base.name}:{parts[1]}", base.fn,
-                              base.larger_is_better), parts[1]
+                              base.larger_is_better,
+                              segmented=_SEGMENTED.get(base.name)), parts[1]
     if head in _BY_NAME:
         return _BY_NAME[head], None
     raise ValueError(f"unknown evaluator {spec!r}; known: {sorted(_BY_NAME)}")

@@ -178,7 +178,7 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
             random_effect_type=self.config.random_effect_type,
             feature_shard=self.config.feature_shard,
             task_type=self.task_type,
-            coefficients=jnp.zeros((E, dl), self.red.blocks.x.dtype),
+            coefficients=jnp.zeros((E, dl), self.red.dtype),
             entity_ids=self.entity_id_values,
             projection=self.red.projection,
             global_dim=self.red.global_dim,
@@ -187,13 +187,22 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
     def update(self, model: RandomEffectModel, offsets: jax.Array
                ) -> Tuple[RandomEffectModel, SolveResult]:
         """reference: RandomEffectCoordinate.updateModel — the 3-way join +
-        per-entity local solves become one gather + one batched solve."""
+        per-entity local solves become one gather + one batched solve per
+        S-bucket (each size class runs its own compiled program; lanes are
+        contiguous so results concatenate straight back into [E, d])."""
         opt = self.config.optimization
-        blocks = self.red.with_offsets_from_flat(offsets)
-        res = fit_random_effects(
-            blocks, self.loss, self.mesh, x0=model.coefficients,
-            config=opt.optimizer, reg=opt.regularization,
-            reg_weight=opt.regularization_weight)
+        results = []
+        for bucket in self.red.buckets:
+            blocks = bucket.with_offsets_from_flat(offsets)
+            lo = bucket.lane_start
+            res_b = fit_random_effects(
+                blocks, self.loss, self.mesh,
+                x0=model.coefficients[lo: lo + bucket.num_entities],
+                config=opt.optimizer, reg=opt.regularization,
+                reg_weight=opt.regularization_weight)
+            results.append(res_b)
+        res = (results[0] if len(results) == 1 else jax.tree_util.tree_map(
+            lambda *a: jnp.concatenate(a, axis=0), *results))
         new_model = dataclasses.replace(model, coefficients=res.x)
         return new_model, res
 
@@ -228,7 +237,7 @@ class FactoredRandomEffectCoordinate(_EntityCoordinateBase):
         E = self.red.num_entities
         k = self.config.latent_dim
         d = self.red.global_dim
-        dtype = self.red.blocks.x.dtype
+        dtype = self.red.dtype
         return FactoredRandomEffectModel(
             random_effect_type=self.config.random_effect_type,
             feature_shard=self.config.feature_shard,

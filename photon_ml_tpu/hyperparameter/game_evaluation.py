@@ -98,7 +98,8 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameResult]):
         config = self._vector_to_config(candidate)
         initial = (self._best_result.model
                    if self.warm_start and self._best_result is not None else None)
-        result = GameEstimator(config, self.estimator.mesh).fit(
+        result = GameEstimator(config, self.estimator.mesh,
+                               emitter=self.estimator.emitter).fit(
             self.data, self.validation_data, self.evaluator_specs,
             initial_model=initial)
         self.observe(result)
