@@ -49,6 +49,10 @@ class FixedEffectCoordinateConfig:
     feature_shard: str
     optimization: GLMOptimizationConfig = GLMOptimizationConfig()
     normalization: NormalizationType = NormalizationType.NONE
+    # None = auto: shard coefficients over the mesh feature axis whenever the
+    # mesh has one wider than 1 (reference regime: >200k-feature
+    # treeAggregate depth switch, GameEstimator.scala:667-669)
+    shard_features: Optional[bool] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,6 +160,7 @@ class GameTrainingConfig:
                 coords[name] = {"kind": "fixed_effect",
                                 "feature_shard": c.feature_shard,
                                 "normalization": c.normalization.value,
+                                "shard_features": c.shard_features,
                                 "optimization": enc_glm(c.optimization)}
             elif isinstance(c, FactoredRandomEffectCoordinateConfig):
                 coords[name] = {"kind": "factored_random_effect",
@@ -208,7 +213,8 @@ class GameTrainingConfig:
                 coords[name] = FixedEffectCoordinateConfig(
                     feature_shard=c["feature_shard"],
                     optimization=dec_glm(c["optimization"]),
-                    normalization=NormalizationType(c.get("normalization", "none")))
+                    normalization=NormalizationType(c.get("normalization", "none")),
+                    shard_features=c.get("shard_features"))
             elif c["kind"] == "factored_random_effect":
                 coords[name] = FactoredRandomEffectCoordinateConfig(
                     random_effect_type=c["random_effect_type"],

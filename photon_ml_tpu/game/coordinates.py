@@ -43,6 +43,7 @@ from photon_ml_tpu.parallel.factored import (
 )
 from photon_ml_tpu.models.glm import model_for_task
 from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+from photon_ml_tpu.ops import features as fops
 from photon_ml_tpu.ops.normalization import (
     NormalizationContext, NormalizationType, build_normalization_context,
 )
@@ -67,15 +68,31 @@ class FixedEffectCoordinate:
         self.task_type = task_type
         self.loss = TASK_LOSSES[task_type]
         self.mesh = mesh
-        self.x = jnp.asarray(dataset.feature_shards[config.feature_shard])
+        # dense arrays pass through; scipy.sparse shards become PaddedSparse
+        # (the wide-model product path, ops/features.py)
+        self.x = fops.as_feature_matrix(dataset.feature_shards[config.feature_shard])
         self.labels = jnp.asarray(dataset.response)
         self.weights = (None if dataset.weights is None
                         else jnp.asarray(dataset.weights))
-        self.dim = self.x.shape[1]
+        self.dim = fops.num_features(self.x)
         self._key = jax.random.PRNGKey(seed)
+        # shard coefficients over the mesh feature axis: explicit config wins,
+        # otherwise automatic whenever the mesh carries a feature axis > 1
+        # (so `--mesh 4x2` actually shards; reference wide-model regime,
+        # GameEstimator.scala:667-669)
+        from photon_ml_tpu.parallel.mesh import FEATURE_AXIS
+        self.shard_features = (config.shard_features
+                               if config.shard_features is not None
+                               else mesh is not None
+                               and mesh.shape.get(FEATURE_AXIS, 1) > 1)
 
         self.norm: Optional[NormalizationContext] = None
         if config.normalization != NormalizationType.NONE:
+            if not isinstance(self.x, jax.Array):
+                raise ValueError(
+                    "normalization requires a dense feature shard (stats over "
+                    "a sparse shard would densify it); use normalization=NONE "
+                    "for sparse/wide coordinates")
             imap = dataset.index_maps.get(config.feature_shard)
             intercept = (imap.intercept_index if imap is not None
                          else self.dim - 1)  # intercept-last convention
@@ -112,7 +129,8 @@ class FixedEffectCoordinate:
             x0 = self.norm.model_to_transformed_space(x0)
         if self.mesh is not None:
             res = fit_fixed_effect(obj, x0, self.mesh, opt.optimizer,
-                                   opt.regularization, opt.regularization_weight)
+                                   opt.regularization, opt.regularization_weight,
+                                   shard_features=self.shard_features)
         else:
             res = _cached_solver(opt.optimizer, opt.regularization)(
                 obj, x0, jnp.asarray(opt.regularization_weight, self.x.dtype))
@@ -126,7 +144,7 @@ class FixedEffectCoordinate:
 
     def score(self, model: FixedEffectModel) -> jax.Array:
         """Margin contribution on the TRAINING data, canonical order."""
-        return self.x @ model.glm.coefficients.means
+        return fops.matvec(self.x, model.glm.coefficients.means)
 
     def regularization_term(self, model: FixedEffectModel) -> float:
         """reference: Coordinate.computeRegularizationTermValue.  For a

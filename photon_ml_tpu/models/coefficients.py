@@ -34,9 +34,13 @@ class Coefficients:
         return self.means.shape[-1]
 
     def compute_score(self, x) -> jax.Array:
-        """x may be [d] or a feature matrix [n, d] (dense or BCOO).
-        reference: Coefficients.computeScore (Coefficients.scala:53)."""
-        return x @ self.means
+        """x may be [d] or a feature matrix [n, d] (dense, BCOO, or
+        PaddedSparse).  reference: Coefficients.computeScore
+        (Coefficients.scala:53)."""
+        if x.ndim == 1:
+            return x @ self.means
+        from photon_ml_tpu.ops import features as fops
+        return fops.matvec(x, self.means)
 
     @staticmethod
     def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":

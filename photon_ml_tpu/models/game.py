@@ -45,7 +45,8 @@ class FixedEffectModel:
         return type(self.glm).task_type
 
     def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
-        x = jnp.asarray(dataset.feature_shards[self.feature_shard])
+        from photon_ml_tpu.ops import features as fops
+        x = fops.as_feature_matrix(dataset.feature_shards[self.feature_shard])
         if mesh is not None:
             from photon_ml_tpu.parallel.fixed_effect import score_fixed_effect
             return score_fixed_effect(self.glm, x, mesh)

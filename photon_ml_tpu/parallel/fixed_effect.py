@@ -103,7 +103,8 @@ def fit_fixed_effect(
 @functools.lru_cache(maxsize=8)
 def _cached_scorer():
     def _score(means, x, offsets):
-        z = x @ means
+        from photon_ml_tpu.ops import features as fops
+        z = fops.matvec(x, means)
         return z if offsets is None else z + offsets
     return jax.jit(_score)
 

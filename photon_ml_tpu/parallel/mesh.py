@@ -76,15 +76,22 @@ def pad_and_shard_rows(mesh: Mesh, *arrays):
     them sharded over "data".  Returns (original_n, [padded arrays...]);
     callers slice results back to original_n.  The one shared implementation
     of the pad/shard/slice pattern used by distributed scoring and training
-    entry points."""
+    entry points.  Accepts FeatureMatrix values (e.g. PaddedSparse) — their
+    array leaves are padded and sharded leaf-wise."""
+    from photon_ml_tpu.ops import features as fops
     n = arrays[0].shape[0]
     rem = (-n) % mesh.shape[DATA_AXIS]
     out = []
     for a in arrays:
-        a = jnp.asarray(a)
-        if rem:
-            a = jnp.concatenate([a, jnp.zeros((rem,) + a.shape[1:], a.dtype)])
-        out.append(jax.device_put(a, data_sharding(mesh, a.ndim)))
+        if isinstance(a, jax.Array) or not hasattr(a, "tree_flatten"):
+            a = jnp.asarray(a)
+            if rem:
+                a = jnp.concatenate([a, jnp.zeros((rem,) + a.shape[1:], a.dtype)])
+            out.append(jax.device_put(a, data_sharding(mesh, a.ndim)))
+        else:
+            a = fops.pad_rows(a, rem)
+            out.append(jax.tree_util.tree_map(
+                lambda l: jax.device_put(l, data_sharding(mesh, np.ndim(l))), a))
     return n, out
 
 
