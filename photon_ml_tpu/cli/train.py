@@ -77,6 +77,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-listener", action="append", default=[],
                    help="dotted class path of an EventListener to register "
                         "(repeatable; reference: Driver.scala:108-118)")
+    p.add_argument("--profile", action="store_true",
+                   help="record a jax.profiler trace of the training run "
+                        "into <output-dir>/profile (the TPU-native "
+                        "replacement for the reference's Timed/Spark event "
+                        "log; view with TensorBoard or xprof)")
     return p
 
 
@@ -156,6 +161,14 @@ def main(argv=None) -> int:
             emitter.register_listener_class(dotted)
         emitter.send_event(SetupEvent(params=vars(args)))
 
+    profile_ctx = None
+    if args.profile:
+        profile_dir = os.path.join(args.output_dir, "profile")
+        os.makedirs(profile_dir, exist_ok=True)
+        profile_ctx = jax.profiler.trace(profile_dir)
+        profile_ctx.__enter__()
+        print(f"profiling to {profile_dir}", file=sys.stderr)
+
     try:
         if args.config:
             with open(args.config) as f:
@@ -228,6 +241,8 @@ def main(argv=None) -> int:
         print(json.dumps(summary))
         return 0
     finally:
+        if profile_ctx is not None:
+            profile_ctx.__exit__(None, None, None)
         # listeners flush buffered events in close() — run even when
         # training/validation/tuning raises
         if emitter is not None:

@@ -305,25 +305,60 @@ def write_container(path: str, schema_json: Any, records: Iterable[dict],
         flush()
 
 
+def _read_header(f: BinaryIO, path: str):
+    """-> (schema_json, codec, sync marker); leaves f at the first block."""
+    if f.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    header = {}
+    while True:
+        n = read_long(f)
+        if n == 0:
+            break
+        if n < 0:
+            read_long(f)
+            n = -n
+        for _ in range(n):
+            k = read_bytes(f).decode()
+            header[k] = read_bytes(f)
+    schema_json = json.loads(header["avro.schema"])
+    codec = header.get("avro.codec", b"null").decode()
+    return schema_json, codec, f.read(16)
+
+
+def iter_raw_blocks(path: str):
+    """-> (schema_json, iterator of (record_count, decompressed bytes)).
+
+    The block-granular read path for vectorized/native decoders.  The header
+    is read eagerly and the file closed; the generator reopens it, so an
+    abandoned iterator never holds an fd."""
+    with open(path, "rb") as f:
+        schema_json, codec, _sync = _read_header(f, path)
+        data_start = f.tell()
+
+    def blocks():
+        with open(path, "rb") as f:
+            f.seek(data_start)
+            while True:
+                try:
+                    count = read_long(f)
+                except EOFError:
+                    return
+                size = read_long(f)
+                data = f.read(size)
+                if codec == "deflate":
+                    data = zlib.decompress(data, -15)
+                elif codec != "null":
+                    raise ValueError(f"unsupported codec {codec}")
+                f.read(16)  # sync marker
+                yield count, data
+
+    return schema_json, blocks()
+
+
 def read_container(path: str) -> Iterator[dict]:
     with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an Avro container file")
-        header = {}
-        while True:
-            n = read_long(f)
-            if n == 0:
-                break
-            if n < 0:
-                read_long(f)
-                n = -n
-            for _ in range(n):
-                k = read_bytes(f).decode()
-                header[k] = read_bytes(f)
-        schema_json = json.loads(header["avro.schema"])
-        codec = header.get("avro.codec", b"null").decode()
+        schema_json, codec, sync = _read_header(f, path)
         schema = Schema(schema_json)
-        sync = f.read(16)
         while True:
             try:
                 count = read_long(f)

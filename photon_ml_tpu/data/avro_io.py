@@ -117,6 +117,59 @@ def write_training_examples(
     write_container(path, TRAINING_EXAMPLE_AVRO, gen())
 
 
+def _read_training_examples_native(paths, index_map):
+    """Columnar fast path over the native block decoder; None -> fall back."""
+    from photon_ml_tpu.data import avro_native
+    required = ("label", "features#count", "features.name", "features.term",
+                "features.value", "uid#present", "uid", "weight#present",
+                "weight", "offset#present", "offset")
+    cols_list = []
+    for p in paths:
+        cols = avro_native.read_columnar(p)
+        if cols is None or any(k not in cols for k in required):
+            # unsupported schema shape OR a schema variant missing optional
+            # fields -> pure-Python path (which tolerates absent fields)
+            return None
+        cols_list.append(cols)
+
+    y = np.concatenate([c["label"] for c in cols_list])
+    n = len(y)
+    counts = np.concatenate([c["features#count"] for c in cols_list])
+    values = np.concatenate([c["features.value"] for c in cols_list])
+    names: List[str] = []
+    terms: List[str] = []
+    for c in cols_list:
+        names.extend(c["features.name"].to_list())
+        terms.extend(c["features.term"].to_list())
+    if index_map is None:
+        index_map = build_index_map(list(zip(names, terms)),
+                                    add_intercept=True)
+    col_idx = np.asarray([index_map.index_of(nm, tm)
+                          for nm, tm in zip(names, terms)], dtype=np.int64)
+    row_idx = np.repeat(np.arange(n), counts)
+
+    x = np.zeros((n, index_map.size))
+    valid = col_idx >= 0
+    x[row_idx[valid], col_idx[valid]] = values[valid]
+    if index_map.intercept_index is not None:
+        x[:, index_map.intercept_index] = 1.0
+
+    def opt_f64(key, default):
+        present = np.concatenate([c[f"{key}#present"] for c in cols_list])
+        vals = np.concatenate([c[key] for c in cols_list])
+        return bool(present.any()), np.where(present == 1, vals, default)
+
+    any_w, weights = opt_f64("weight", 1.0)
+    any_o, offsets = opt_f64("offset", 0.0)
+    uid_present = np.concatenate([c["uid#present"] for c in cols_list])
+    uid_strs: List[str] = []
+    for c in cols_list:
+        uid_strs.extend(c["uid"].to_list())
+    uids = [s if p else None for s, p in zip(uid_strs, uid_present)]
+    return (x, y, weights if any_w else None, offsets if any_o else None,
+            uids, index_map)
+
+
 def read_training_examples(
     paths: str | Iterable[str],
     index_map: Optional[IndexMap] = None,
@@ -126,10 +179,15 @@ def read_training_examples(
 
     Two-pass like the reference FeatureIndexingJob + AvroDataReader: build
     the (name, term) index map first (unless given), then fill the dense
-    matrix with the intercept column appended last."""
+    matrix with the intercept column appended last.  Decode runs through the
+    native block decoder (data/avro_native.py) when available, falling back
+    to the pure-Python codec."""
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
     paths = list(paths)
+    fast = _read_training_examples_native(paths, index_map)
+    if fast is not None:
+        return fast
     if index_map is None:
         names = []
         for p in paths:

@@ -1,0 +1,239 @@
+"""Schema-compiled native Avro decode: Python compiler + ctypes bindings.
+
+VERDICT r2 item 9: the per-record pure-Python codec is the ingest
+bottleneck for corpus-scale files (the role of the reference's
+AvroDataReader on Spark executors, AvroDataReader.scala:53-451).  Here a
+record schema is compiled once into a flat int32 op program, and the C
+interpreter (photon_ml_tpu/native/avro_decode.c) executes it per record
+over each decompressed container block, appending leaf values into typed
+columns — one C loop instead of one Python decode call per record.
+
+Columns come back as numpy arrays keyed by field path:
+  "label" -> float64 [n];  "uid" -> StrColumn;  "uid#present" -> int64 [n]
+  "features#count" -> int64 [n];  "features.name" -> StrColumn (flattened)
+
+Unsupported schema shapes (unions beyond [null, X], maps with non-string
+values, fixed) make `compile_schema` return None and callers fall back to
+the pure-Python codec — behavior, not availability, is the contract.
+"""
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.avro_codec import iter_raw_blocks
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "avro_decode.c")
+_SO = os.path.join(_NATIVE_DIR, "libavrodec.so")
+
+OP_LONG, OP_DOUBLE, OP_FLOAT, OP_BOOL, OP_STRING, OP_ENUM, OP_OPT, \
+    OP_ARRAY, OP_MAP_SKIP = range(9)
+KIND_I64, KIND_F64, KIND_STR = range(3)
+
+_PRIMITIVE_OPS = {"long": (OP_LONG, KIND_I64), "int": (OP_LONG, KIND_I64),
+                  "double": (OP_DOUBLE, KIND_F64),
+                  "float": (OP_FLOAT, KIND_F64),
+                  "boolean": (OP_BOOL, KIND_I64),
+                  "string": (OP_STRING, KIND_STR),
+                  "bytes": (OP_STRING, KIND_STR)}
+
+_lib = None
+_lib_failed = False
+
+
+def _load_lib():
+    """Compile (if stale) and load the shared library; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(["cc", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],
+                           check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(_SO)
+        lib.avrodec_decode_block.restype = ctypes.c_int64
+        lib.avrodec_decode_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int32]
+        lib.avrodec_alloc_cols.restype = ctypes.c_void_p
+        lib.avrodec_alloc_cols.argtypes = [ctypes.c_int32,
+                                           ctypes.POINTER(ctypes.c_int32)]
+        lib.avrodec_free_cols.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        for name, restype in (("avrodec_col_len", ctypes.c_int64),
+                              ("avrodec_col_blob_len", ctypes.c_int64),
+                              ("avrodec_col_i64", ctypes.POINTER(ctypes.c_int64)),
+                              ("avrodec_col_f64", ctypes.POINTER(ctypes.c_double)),
+                              ("avrodec_col_blob", ctypes.POINTER(ctypes.c_uint8))):
+            fn = getattr(lib, name)
+            fn.restype = restype
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+@dataclasses.dataclass
+class StrColumn:
+    """Flattened UTF-8 column: `offsets[i]` is the END byte offset of
+    element i in `blob` (start = offsets[i-1] or 0)."""
+
+    offsets: np.ndarray  # int64 [n]
+    blob: bytes
+
+    def __len__(self) -> int:
+        return len(self.offsets)
+
+    def to_list(self) -> List[str]:
+        out, start = [], 0
+        b = self.blob
+        for end in self.offsets.tolist():
+            out.append(b[start:end].decode("utf-8"))
+            start = end
+        return out
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.to_list(), dtype=object)
+
+
+@dataclasses.dataclass
+class DecodePlan:
+    program: np.ndarray             # int32 tokens
+    columns: List[Tuple[str, int]]  # (path, KIND_*)
+
+
+def compile_schema(schema_json) -> Optional[DecodePlan]:
+    """Record schema -> op program, or None when a shape is unsupported."""
+    tokens: List[int] = []
+    columns: List[Tuple[str, int]] = []
+    names: Dict[str, dict] = {}
+
+    def new_col(path: str, kind: int) -> int:
+        columns.append((path, kind))
+        return len(columns) - 1
+
+    def emit(node, path: str) -> bool:
+        if isinstance(node, str):
+            if node in names:
+                return emit(names[node], path)
+            if node == "null":
+                return True  # nothing to read, nothing to record
+            if node not in _PRIMITIVE_OPS:
+                return False
+            op, kind = _PRIMITIVE_OPS[node]
+            tokens.extend([op, new_col(path, kind)])
+            return True
+        if isinstance(node, list):  # union: only [null, X] / [X, null]
+            if len(node) != 2 or "null" not in node:
+                return False
+            null_idx = node.index("null")
+            other = node[1 - null_idx]
+            present = new_col(path + "#present", KIND_I64)
+            tokens.extend([OP_OPT, null_idx, present])
+            fixup = len(tokens)
+            tokens.append(-1)  # body length placeholder
+            if not emit(other, path):
+                return False
+            tokens[fixup] = len(tokens) - fixup - 1
+            return True
+        t = node["type"]
+        if t == "record":
+            full = node.get("namespace", "") + "." + node["name"] \
+                if node.get("namespace") else node["name"]
+            names[full] = names[node["name"]] = {
+                "type": "record", "fields": node["fields"]}
+            for f in node["fields"]:
+                fpath = f"{path}.{f['name']}" if path else f["name"]
+                if not emit(f["type"], fpath):
+                    return False
+            return True
+        if t == "array":
+            count = new_col(path + "#count", KIND_I64)
+            tokens.extend([OP_ARRAY, count])
+            fixup = len(tokens)
+            tokens.append(-1)
+            if not emit(node["items"], path):
+                return False
+            tokens[fixup] = len(tokens) - fixup - 1
+            return True
+        if t == "map":
+            values = node["values"]
+            if values not in ("string", "bytes"):
+                return False
+            tokens.append(OP_MAP_SKIP)
+            return True
+        if t == "enum":
+            tokens.extend([OP_ENUM, new_col(path, KIND_I64)])
+            return True
+        if isinstance(t, (dict, list)):
+            return emit(t, path)  # {"type": {...nested...}}
+        return emit(t, path) if t in names or t in _PRIMITIVE_OPS else False
+
+    if not emit(schema_json, ""):
+        return None
+    return DecodePlan(np.asarray(tokens, dtype=np.int32), columns)
+
+
+def read_columnar(path: str):
+    """Decode a container file into columns, or None when the native path
+    is unavailable / the schema is unsupported (callers fall back)."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    schema_json, blocks = iter_raw_blocks(path)
+    plan = compile_schema(schema_json)
+    if plan is None:
+        return None
+
+    ncols = len(plan.columns)
+    kinds = np.asarray([k for _, k in plan.columns], dtype=np.int32)
+    prog = plan.program
+    handle = lib.avrodec_alloc_cols(
+        ncols, kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if not handle:
+        return None
+    try:
+        for count, data in blocks:
+            consumed = lib.avrodec_decode_block(
+                data, len(data), count,
+                prog.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                len(prog), handle, ncols)
+            if consumed != len(data):
+                raise ValueError(
+                    f"{path}: native Avro decode failed (consumed {consumed} "
+                    f"of {len(data)} block bytes)")
+        def as_np(ptr, n, dtype):
+            # string_at does one bulk memcpy; frombuffer views it (the
+            # ctypeslib.as_array route converts elementwise — far too slow)
+            if not n:
+                return np.zeros(0, dtype)
+            raw = ctypes.string_at(ptr, n * np.dtype(dtype).itemsize)
+            return np.frombuffer(raw, dtype=dtype)
+
+        out = {}
+        for i, (name, kind) in enumerate(plan.columns):
+            n = lib.avrodec_col_len(handle, i)
+            if kind == KIND_F64:
+                out[name] = as_np(lib.avrodec_col_f64(handle, i), n,
+                                  np.float64)
+            elif kind == KIND_I64:
+                out[name] = as_np(lib.avrodec_col_i64(handle, i), n,
+                                  np.int64)
+            else:
+                bn = lib.avrodec_col_blob_len(handle, i)
+                blob = lib.avrodec_col_blob(handle, i)
+                out[name] = StrColumn(
+                    as_np(lib.avrodec_col_i64(handle, i), n, np.int64),
+                    ctypes.string_at(blob, bn) if bn else b"")
+        return out
+    finally:
+        lib.avrodec_free_cols(handle, ncols)

@@ -59,6 +59,11 @@ class RandomEffectDataConfig:
     # RandomProjection(dim))
     projector: str = "index_map"
     seed: int = 7
+    # cap on the number of S-buckets: each bucket shape is a separate XLA
+    # compile of the vmapped per-entity solver, so unbounded power-of-two
+    # classes trade compile wall-clock for padding efficiency.  None = one
+    # bucket per power-of-two class.
+    max_buckets: Optional[int] = 4
 
 
 @dataclasses.dataclass
@@ -344,8 +349,16 @@ def _build_random_effect_dataset(
     entity_position[entity_ids] = np.arange(E)
 
     pow2_lane = _ceil_pow2(counts_lane)
+    # group adjacent power-of-two classes when there are more classes than
+    # max_buckets (compile-count cap; padding cost shows in padding_stats)
+    uniq_keys, key_of_lane = np.unique(pow2_lane, return_inverse=True)
+    n_classes = len(uniq_keys)
+    mb = config.max_buckets
+    if mb is not None and n_classes > mb > 0:
+        width = -(-n_classes // mb)
+        key_of_lane = ((n_classes - 1) - key_of_lane) // width
     bucket_bounds = np.concatenate(
-        [[0], np.flatnonzero(np.diff(pow2_lane)) + 1, [E]])
+        [[0], np.flatnonzero(np.diff(key_of_lane)) + 1, [E]])
 
     # kept rows in (lane, canonical-row) order; per-lane slot index
     lane_rows = lane_of[grp]

@@ -16,6 +16,7 @@ the way out (reference: GeneralizedLinearOptimizationProblem.createModel).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -41,6 +42,10 @@ class TrainedModel:
     reg_weight: float
     model: GeneralizedLinearModel
     result: SolveResult
+    # host-side wall clock of the whole solve (reference: the per-iteration
+    # times in OptimizationStatesTracker.scala:32-102 — iterations run inside
+    # one XLA program here, so the host can only observe the full solve)
+    wall_s: float = 0.0
 
 
 def train_glm(
@@ -89,7 +94,10 @@ def train_glm(
     # strongest regularization first so warm starts move from the most to the
     # least constrained problem (reference: ModelTraining.scala sorted sweep)
     for lam in sorted(regularization_weights, reverse=True):
+        t0 = time.perf_counter()
         res = _solve(x0, jnp.asarray(lam, dtype))
+        res.x.block_until_ready()
+        wall_s = time.perf_counter() - t0
         c_norm = res.x
         c_orig = (normalization.model_to_original_space(c_norm)
                   if normalization is not None else c_norm)
@@ -99,7 +107,8 @@ def train_glm(
                 c_orig, _hessian_diag(c_orig, l2_w))
         else:
             coeffs = Coefficients(c_orig)
-        out.append(TrainedModel(float(lam), model_for_task(task_type, coeffs), res))
+        out.append(TrainedModel(float(lam), model_for_task(task_type, coeffs),
+                                res, wall_s=wall_s))
         if warm_start:
             x0 = c_norm
     return out

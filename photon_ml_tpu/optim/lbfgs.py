@@ -57,9 +57,18 @@ class _State(NamedTuple):
     y_buf: jax.Array        # [m, d] gradient-difference history
     rho: jax.Array          # [m] 1/(s.y)
     num_pairs: jax.Array    # pairs stored so far
+    f_small: jax.Array      # consecutive sub-tolerance f-changes
     reason: jax.Array
     loss_hist: jax.Array
     gnorm_hist: jax.Array
+
+
+# In float32 a single step's progress can round to an exact zero f-change
+# while the solve is far from done (the value's resolution is ~1.2e-7
+# relative; the reference runs in JVM double where this cannot happen).
+# Function-value convergence therefore requires this many CONSECUTIVE
+# sub-tolerance changes before it is declared.
+_F_CONV_PERSISTENCE = 3
 
 
 def _pseudo_gradient(x, g, l1):
@@ -149,6 +158,27 @@ def lbfgs(
             x = jnp.minimum(x, upper)
         return x
 
+    def box_blocked(x, g):
+        """Coordinates pinned at an active bound (descent would exit the box).
+        The projected gradient zeroed there is the KKT residual."""
+        blocked = jnp.zeros(x.shape, bool)
+        if lower is not None:
+            blocked = blocked | ((x <= lower) & (g > 0))
+        if upper is not None:
+            blocked = blocked | ((x >= upper) & (g < 0))
+        return blocked
+
+    def steer_grad(x, g):
+        """Steering gradient: OWLQN pseudo-gradient under L1; under box
+        constraints the PROJECTED gradient, so the two-loop direction lives
+        in the free subspace instead of being clipped to a stall by iterate
+        projection."""
+        if use_l1:
+            return _pseudo_gradient(x, g, l1)
+        if use_box:
+            return jnp.where(box_blocked(x, g), 0.0, g)
+        return g
+
     def full_value(x):
         """Value + gradient of the acceptance objective (smooth + L1 term)."""
         v, g = value_and_grad(x)
@@ -165,7 +195,7 @@ def lbfgs(
 
     x0 = project_box(x0)
     f0, g0 = full_value(x0)
-    gnorm0 = jnp.linalg.norm(_pseudo_gradient(x0, g0, l1)) if use_l1 else jnp.linalg.norm(g0)
+    gnorm0 = jnp.linalg.norm(steer_grad(x0, g0))
     # relative gradient convergence, like breeze's default convergence check
     gtol = tolerance * jnp.maximum(gnorm0, 1.0)
 
@@ -175,6 +205,7 @@ def lbfgs(
         x=x0, f=f0, g=g0,
         s_buf=jnp.zeros((m, d), dtype), y_buf=jnp.zeros((m, d), dtype),
         rho=jnp.zeros((m,), dtype), num_pairs=jnp.asarray(0, jnp.int32),
+        f_small=jnp.asarray(0, jnp.int32),
         reason=jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
         loss_hist=jnp.full((max_iterations + 1,), nan).at[0].set(f0),
         gnorm_hist=jnp.full((max_iterations + 1,), nan).at[0].set(gnorm0),
@@ -184,12 +215,17 @@ def lbfgs(
         return (st.k < max_iterations) & (st.reason == ConvergenceReason.NOT_CONVERGED)
 
     def body(st: _State) -> _State:
-        steer = _pseudo_gradient(st.x, st.g, l1) if use_l1 else st.g
+        steer = steer_grad(st.x, st.g)
         p = -_two_loop(steer, st.s_buf, st.y_buf, st.rho, st.num_pairs, m)
         if use_l1:
             # direction must agree with -pseudo-gradient sign-wise
             p = jnp.where(p * (-steer) > 0, p, 0.0)
             orthant = jnp.where(st.x != 0, jnp.sign(st.x), jnp.sign(-steer))
+        if use_box:
+            # keep the step in the free subspace: a component against an
+            # active bound would be clipped by projection anyway, but leaving
+            # it in corrupts the Armijo displacement and the curvature pairs
+            p = jnp.where(box_blocked(st.x, st.g), 0.0, p)
         dd = jnp.dot(steer, p)
         # fall back to steepest descent if not a descent direction
         bad = dd >= 0
@@ -233,6 +269,13 @@ def lbfgs(
         # curvature pair from raw gradients (standard OWLQN choice)
         s = x_new - st.x
         yv = g_new - st.g
+        if use_box:
+            # restrict the pair to the free subspace at the accepted point:
+            # gradient deltas on pinned coordinates are not curvature the
+            # free-space two-loop should learn
+            bl = box_blocked(x_new, g_new)
+            s = jnp.where(bl, 0.0, s)
+            yv = jnp.where(bl, 0.0, yv)
         sy = jnp.dot(s, yv)
         store = ls_ok & (sy > _CURV_EPS)
         slot = st.num_pairs % m
@@ -241,11 +284,12 @@ def lbfgs(
         rho = jnp.where(store, st.rho.at[slot].set(1.0 / jnp.where(store, sy, 1.0)), st.rho)
         num_pairs = st.num_pairs + jnp.where(store, 1, 0)
 
-        gnorm_new = (jnp.linalg.norm(_pseudo_gradient(x_new, g_new, l1))
-                     if use_l1 else jnp.linalg.norm(g_new))
+        gnorm_new = jnp.linalg.norm(steer_grad(x_new, g_new))
         # convergence checks (reference Optimizer.scala:136-150 reasons)
-        f_conv = jnp.abs(st.f - f_new) <= tolerance * jnp.maximum(
+        f_small_now = jnp.abs(st.f - f_new) <= tolerance * jnp.maximum(
             jnp.maximum(jnp.abs(st.f), jnp.abs(f_new)), 1.0)
+        f_small = jnp.where(f_small_now, st.f_small + 1, 0)
+        f_conv = f_small >= _F_CONV_PERSISTENCE
         g_conv = gnorm_new <= gtol
         reason = jnp.where(
             ~ls_ok, ConvergenceReason.LINE_SEARCH_FAILED,
@@ -263,7 +307,7 @@ def lbfgs(
         return _State(
             k=k, x=x_new, f=f_new, g=g_new,
             s_buf=s_buf, y_buf=y_buf, rho=rho, num_pairs=num_pairs,
-            reason=reason,
+            f_small=f_small, reason=reason,
             loss_hist=st.loss_hist.at[k].set(f_new),
             gnorm_hist=st.gnorm_hist.at[k].set(gnorm_new),
         )

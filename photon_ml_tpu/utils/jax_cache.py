@@ -1,0 +1,29 @@
+"""Persistent XLA compilation cache.
+
+The GAME product path compiles one program per (bucket shape, coordinate)
+pair; on a cold process that compile wall-clock dominates small fits.  The
+reference has no equivalent cost (JVM/Breeze interprets), so we keep the
+cache warm across processes with JAX's persistent compilation cache, stored
+inside the repo (the only writable project location).
+"""
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+
+def enable_persistent_cache(path: str | None = None) -> str:
+    """Idempotent; returns the cache directory in use."""
+    import jax
+
+    path = path or os.environ.get("PHOTON_JAX_CACHE", _DEFAULT)
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # older jax without these flags: cache is best-effort
+        pass
+    return path

@@ -64,8 +64,12 @@ def cli_env(tmp_path, rng):
 
 def _run_cli(module, argv):
     cmd = [sys.executable, "-m", module] + argv
+    # 8 virtual devices so `--mesh auto` exercises the REAL multi-device
+    # product path end-to-end (VERDICT r2 item 8: CLI e2e must not silently
+    # collapse to one device)
     env = {"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin:/usr/local/bin",
-           "JAX_PLATFORMS": "cpu", "HOME": "/root"}
+           "JAX_PLATFORMS": "cpu", "HOME": "/root",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
     return subprocess.run(cmd, capture_output=True, text=True, env=env,
                           timeout=420)
 
