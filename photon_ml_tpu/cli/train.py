@@ -110,8 +110,30 @@ def _load_dataset(path: str, task: str):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
-        level=logging.INFO if args.verbose else logging.WARNING,
-        format="%(asctime)s %(message)s", stream=sys.stderr)
+        level=logging.INFO, format="%(asctime)s %(message)s",
+        stream=sys.stderr)
+    # stderr stays quiet unless --verbose; the persisted job log always
+    # captures INFO (reference: PhotonLogger writes the job log next to the
+    # job output on HDFS, photon-lib/.../util/PhotonLogger.scala:36-521)
+    for h in logging.getLogger().handlers:
+        h.setLevel(logging.INFO if args.verbose else logging.WARNING)
+    os.makedirs(args.output_dir, exist_ok=True)
+    _fh = logging.FileHandler(os.path.join(args.output_dir, "training.log"))
+    _fh.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+    _fh.setLevel(logging.INFO)
+    logging.getLogger().addHandler(_fh)
+    log = logging.getLogger("photon_ml_tpu.train")
+    try:
+        return _run(args, log)
+    finally:
+        # main() is a callable API: don't leak this job's log handler into
+        # the next in-process call, whatever stage raised
+        logging.getLogger().removeHandler(_fh)
+        _fh.close()
+
+
+def _run(args, log) -> int:
+    log.info("args: %s", vars(args))
 
     import jax
     if args.x64:
@@ -130,6 +152,8 @@ def main(argv=None) -> int:
     train = _load_dataset(args.train_data, args.task)
     val = (_load_dataset(args.validation_data, args.task)
            if args.validation_data else None)
+    log.info("loaded train: %d rows, shards %s", train.num_rows,
+             {s: x.shape[1] for s, x in train.feature_shards.items()})
     print(f"loaded train: {train.num_rows} rows, shards "
           f"{ {s: x.shape[1] for s, x in train.feature_shards.items()} }",
           file=sys.stderr)
@@ -238,6 +262,9 @@ def main(argv=None) -> int:
         }
         with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
+        log.info("summary: %s", summary)
+        for name, t in getattr(best.descent, "timings", {}).items():
+            log.info("phase %s: %.3fs", name, t)
         print(json.dumps(summary))
         return 0
     finally:

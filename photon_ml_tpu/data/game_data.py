@@ -27,6 +27,14 @@ import numpy as np
 from photon_ml_tpu.data.index_map import IndexMap
 
 
+def _is_sparse(x) -> bool:
+    try:
+        import scipy.sparse as sp
+        return sp.issparse(x)
+    except ImportError:  # pragma: no cover
+        return False
+
+
 @dataclasses.dataclass
 class InputColumnNames:
     """Remappable input column names (reference: InputColumnsNames.scala)."""
@@ -95,7 +103,14 @@ def save_game_dataset(dataset: GameDataset, path: str) -> None:
     if dataset.weights is not None:
         arrays["weights"] = dataset.weights
     for s, x in dataset.feature_shards.items():
-        arrays[f"shard::{s}"] = x
+        if _is_sparse(x):
+            csr = x.tocsr()
+            arrays[f"spshard::{s}::data"] = csr.data
+            arrays[f"spshard::{s}::indices"] = csr.indices
+            arrays[f"spshard::{s}::indptr"] = csr.indptr
+            arrays[f"spshard::{s}::shape"] = np.asarray(csr.shape)
+        else:
+            arrays[f"shard::{s}"] = x
     for t, idx in dataset.entity_indices.items():
         arrays[f"entidx::{t}"] = idx
         arrays[f"entvocab::{t}"] = np.asarray(dataset.entity_vocabs[t]).astype(object)
@@ -106,6 +121,13 @@ def load_game_dataset(path: str) -> GameDataset:
     z = np.load(path if path.endswith(".npz") else path + ".npz",
                 allow_pickle=True)
     shards, entidx, entvocab = {}, {}, {}
+    sp_names = {k.split("::")[1] for k in z.files if k.startswith("spshard::")}
+    for s in sp_names:
+        import scipy.sparse as sp
+        shards[s] = sp.csr_matrix(
+            (z[f"spshard::{s}::data"], z[f"spshard::{s}::indices"],
+             z[f"spshard::{s}::indptr"]),
+            shape=tuple(z[f"spshard::{s}::shape"]))
     for k in z.files:
         if k.startswith("shard::"):
             shards[k[7:]] = z[k]
@@ -154,7 +176,12 @@ def build_game_dataset(
         vocabs[re_type] = vocab
     return GameDataset(
         response=np.asarray(response, dtype=np.float64),
-        feature_shards={s: np.asarray(x) for s, x in feature_shards.items()},
+        # scipy.sparse shards stay sparse, canonicalized to CSR (row
+        # slicing for subset/validation; the wide fixed-effect regime,
+        # reference: AvroDataReader SparseVector columns); np.asarray on
+        # them would produce a useless 0-d object array
+        feature_shards={s: (x.tocsr() if _is_sparse(x) else np.asarray(x))
+                        for s, x in feature_shards.items()},
         offsets=None if offsets is None else np.asarray(offsets, dtype=np.float64),
         weights=None if weights is None else np.asarray(weights, dtype=np.float64),
         entity_indices=entity_indices,

@@ -98,7 +98,25 @@ def validate_game_dataset(
 
     errors: List[str] = []
     errors.extend(_check_label(task_type, take(dataset.response), rows))
+    from photon_ml_tpu.data.game_data import _is_sparse
     for shard, x in dataset.feature_shards.items():
+        if _is_sparse(x):
+            # sparse shard (wide-FE path): validate the STORED values; the
+            # implicit zeros are finite by construction.  Row slice first
+            # under SAMPLE so the check stays proportional; the COO copy is
+            # built only to NAME the offending row/column once a non-finite
+            # value is known to exist.
+            xs = (x.tocsr()[rows]
+                  if validation_type is DataValidationType.VALIDATE_SAMPLE
+                  else x)
+            if not np.isfinite(xs.data).all():
+                coo = xs.tocoo()
+                i = _first_bad(~np.isfinite(coo.data))
+                errors.append(
+                    f"Data contains row(s) with non-finite feature(s): first "
+                    f"at row {int(rows[coo.row[i]])}, shard {shard!r} column "
+                    f"{int(coo.col[i])}")
+            continue
         vals = take(x)
         if not np.isfinite(vals).all():
             bad_rows, bad_cols = np.nonzero(~np.isfinite(vals))

@@ -96,7 +96,8 @@ def train_glm(
     for lam in sorted(regularization_weights, reverse=True):
         t0 = time.perf_counter()
         res = _solve(x0, jnp.asarray(lam, dtype))
-        res.x.block_until_ready()
+        float(res.value)  # device->host readback: a true sync even where
+        # block_until_ready returns early (tunneled accelerator)
         wall_s = time.perf_counter() - t0
         c_norm = res.x
         c_orig = (normalization.model_to_original_space(c_norm)

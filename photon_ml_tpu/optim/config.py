@@ -91,6 +91,10 @@ class OptimizerConfig:
     # array-like and solve() converts back to arrays
     box_lower: Optional[tuple] = None
     box_upper: Optional[tuple] = None
+    # per-iteration coefficient snapshots in SolveResult.coefficient_history
+    # (reference: ModelTracker per-iteration models); costs [max_iter+1, d]
+    # device memory per solve, so off by default
+    track_coefficients: bool = False
 
     def __post_init__(self):
         for name in ("box_lower", "box_upper"):
@@ -140,7 +144,8 @@ def solve(
                              "(reference: LBFGS.scala:72)")
         return tron(obj.value_and_gradient, obj.hessian_vector, x0,
                     max_iterations=cfg.max_iterations, tolerance=cfg.tolerance,
-                    max_cg_iterations=cfg.max_cg_iterations)
+                    max_cg_iterations=cfg.max_cg_iterations,
+                    track_coefficients=cfg.track_coefficients)
 
     lower = None if cfg.box_lower is None else jnp.asarray(cfg.box_lower, x0.dtype)
     upper = None if cfg.box_upper is None else jnp.asarray(cfg.box_upper, x0.dtype)
@@ -149,4 +154,4 @@ def solve(
                  history=cfg.history,
                  l1_weight=l1_w if reg.has_l1 else None,
                  lower=lower, upper=upper,
-                 value_fn=obj.value)
+                 track_coefficients=cfg.track_coefficients)

@@ -106,6 +106,7 @@ def tron(
     max_iterations: int = 15,
     tolerance: float = 1e-5,
     max_cg_iterations: int = 20,
+    track_coefficients: bool = False,
 ) -> SolveResult:
     """Minimize a twice-differentiable objective from x0."""
     dtype = x0.dtype
@@ -124,6 +125,7 @@ def tron(
         reason: jax.Array
         loss_hist: jax.Array
         gnorm_hist: jax.Array
+        coef_hist: "jax.Array | None"
 
     nan = jnp.asarray(jnp.nan, dtype)
     init = _S(
@@ -135,6 +137,8 @@ def tron(
                       ConvergenceReason.NOT_CONVERGED), jnp.int32),
         loss_hist=jnp.full((max_iterations + 1,), nan).at[0].set(f0),
         gnorm_hist=jnp.full((max_iterations + 1,), nan).at[0].set(gnorm0),
+        coef_hist=(jnp.full((max_iterations + 1, x0.shape[-1]), nan)
+                   .at[0].set(x0) if track_coefficients else None),
     )
 
     def cond(st: _S):
@@ -176,7 +180,9 @@ def tron(
         return _S(k=k, x=x_new, f=f_new, g=g_new, gnorm=gnorm_new,
                   delta=delta_new, failures=failures, reason=reason,
                   loss_hist=st.loss_hist.at[k].set(f_new),
-                  gnorm_hist=st.gnorm_hist.at[k].set(gnorm_new))
+                  gnorm_hist=st.gnorm_hist.at[k].set(gnorm_new),
+                  coef_hist=(None if st.coef_hist is None
+                             else st.coef_hist.at[k].set(x_new)))
 
     st = lax.while_loop(cond, body, init)
     reason = jnp.where(st.reason == ConvergenceReason.NOT_CONVERGED,
@@ -184,4 +190,5 @@ def tron(
                        st.reason)
     return SolveResult(x=st.x, value=st.f, gradient_norm=st.gnorm,
                        iterations=st.k, reason=reason,
-                       loss_history=st.loss_hist, gnorm_history=st.gnorm_hist)
+                       loss_history=st.loss_hist, gnorm_history=st.gnorm_hist,
+                       coefficient_history=st.coef_hist)

@@ -47,6 +47,10 @@ class SolveResult(NamedTuple):
     reason: jax.Array           # int32 ConvergenceReason code
     loss_history: jax.Array     # [max_iter + 1]
     gnorm_history: jax.Array    # [max_iter + 1]
+    # [max_iter + 1, d] iterate snapshots when the solve was run with
+    # track_coefficients (reference: ModelTracker per-iteration models,
+    # photon-api/.../supervised/model/ModelTracker.scala); None otherwise
+    coefficient_history: "jax.Array | None" = None
 
     @property
     def converged(self) -> jax.Array:

@@ -171,3 +171,38 @@ def test_smoothed_hinge_with_box_constraints(rng):
     res = solve(obj, jnp.zeros(6), cfg)
     assert bool(jnp.all(jnp.abs(res.x) <= 0.5 + 1e-12))
     assert float(res.value) < float(obj.value(jnp.zeros(6)))
+
+
+def test_coefficient_history_tracking(rng):
+    """track_coefficients snapshots every iterate (reference: ModelTracker
+    per-iteration models); the last snapshot equals the solution and the
+    history reproduces the loss table."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+    from tests.synthetic import make_glm_data
+
+    x, y, _, _ = make_glm_data(rng, n=300, d=6)
+    obj = GLMObjective(TASK_LOSSES["logistic_regression"],
+                       jnp.asarray(x), jnp.asarray(y))
+    for opt in (OptimizerType.LBFGS, OptimizerType.TRON):
+        cfg = OptimizerConfig(optimizer=opt, max_iterations=30,
+                              track_coefficients=True)
+        res = solve(obj, jnp.zeros(6), cfg,
+                    RegularizationContext(RegularizationType.L2), 0.1)
+        hist = np.asarray(res.coefficient_history)
+        it = int(res.iterations)
+        assert hist.shape[1] == 6
+        np.testing.assert_allclose(hist[it], np.asarray(res.x), rtol=1e-7)
+        # snapshot i re-evaluates to the recorded loss (accepted iterates)
+        l2 = 0.1
+        for i in (0, it):
+            w = hist[i]
+            z = x @ w
+            nll = np.logaddexp(0, -np.where(y > 0.5, 1, -1) * z).sum() \
+                + 0.5 * l2 * w @ w
+            np.testing.assert_allclose(nll, np.asarray(res.loss_history)[i],
+                                       rtol=1e-5)
+        # default: no history
+        res2 = solve(obj, jnp.zeros(6), OptimizerConfig(optimizer=opt),
+                     RegularizationContext(RegularizationType.L2), 0.1)
+        assert res2.coefficient_history is None

@@ -1,0 +1,127 @@
+"""Sparse and wide fixed-effect models as PRODUCT paths (VERDICT r2 item 4).
+
+The reference's wide regime: SparseVector feature columns from
+AvroDataReader (AvroDataReader.scala:332-440) and the >200k-feature
+treeAggregate depth switch (GameEstimator.scala:667-669), with a design
+envelope of ~1e11 coefficients.  Here scipy.sparse shards flow through
+GameEstimator into PaddedSparse (ELL) batches (ops/features.py), shard over
+the mesh data axis like dense rows, and — for wide models — shard
+coefficients over the mesh feature axis.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data import build_game_dataset
+from photon_ml_tpu.game import (
+    FixedEffectCoordinateConfig, GameEstimator, GameTrainingConfig,
+    GLMOptimizationConfig,
+)
+from photon_ml_tpu.optim import (
+    OptimizerConfig, RegularizationContext, RegularizationType,
+)
+from photon_ml_tpu.parallel import make_mesh
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+def _sparse_logistic(rng, n=2000, d=400, nnz_per_row=12):
+    cols = rng.integers(0, d - 1, size=(n, nnz_per_row))
+    vals = rng.normal(size=(n, nnz_per_row))
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    x = sp.csr_matrix((vals.ravel(), (rows, cols.ravel())), shape=(n, d))
+    x[:, d - 1] = 1.0  # intercept column
+    x = x.tocsr()
+    w = rng.normal(size=d) * 0.5
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w)))).astype(float)
+    return x, y
+
+
+def _fe_config(shard_features=None, iters=40):
+    return GameTrainingConfig(
+        task_type="logistic_regression",
+        coordinates={"fixed": FixedEffectCoordinateConfig(
+            "global",
+            GLMOptimizationConfig(
+                optimizer=OptimizerConfig(max_iterations=iters),
+                regularization=L2, regularization_weight=0.1),
+            shard_features=shard_features)},
+        updating_sequence=["fixed"])
+
+
+def test_sparse_fe_matches_dense_on_mesh(rng):
+    """scipy.sparse shard -> PaddedSparse -> distributed fit == dense fit."""
+    x, y = _sparse_logistic(rng)
+    mesh = make_mesh()
+    ds_sparse = build_game_dataset(y, {"global": x})
+    ds_dense = build_game_dataset(y, {"global": x.toarray()})
+    assert sp.issparse(ds_sparse.feature_shards["global"])
+
+    res_s = GameEstimator(_fe_config(), mesh=mesh).fit(ds_sparse)
+    res_d = GameEstimator(_fe_config(), mesh=mesh).fit(ds_dense)
+    np.testing.assert_allclose(res_s.objective_history,
+                               res_d.objective_history, rtol=1e-6)
+    w_s = np.asarray(
+        res_s.model.coordinates["fixed"].glm.coefficients.means)
+    w_d = np.asarray(
+        res_d.model.coordinates["fixed"].glm.coefficients.means)
+    np.testing.assert_allclose(w_s, w_d, rtol=1e-4, atol=1e-6)
+
+
+def test_sparse_subset_and_scoring(rng):
+    """Train/validation splits and model scoring work on sparse shards."""
+    x, y = _sparse_logistic(rng)
+    ds = build_game_dataset(y, {"global": x})
+    rows = np.arange(ds.num_rows)
+    train, val = ds.subset(rows[:1500]), ds.subset(rows[1500:])
+    res = GameEstimator(_fe_config(), mesh=make_mesh()).fit(
+        train, val, evaluator_specs=["AUC"])
+    # d=400 coefficients from 1500 rows: recovery is partial by design;
+    # the gate is "clearly better than chance", not model quality
+    assert res.validation["AUC"] > 0.65
+
+
+@pytest.mark.slow
+def test_wide_model_feature_sharded(rng):
+    """>=200k-feature model (the reference's depth-switch regime): sparse
+    rows + coefficients sharded over a 2-wide feature axis must reproduce
+    the data-parallel solve."""
+    n, d = 1500, 200_128
+    x, y = _sparse_logistic(rng, n=n, d=d, nnz_per_row=16)
+    ds = build_game_dataset(y, {"global": x})
+
+    res_fs = GameEstimator(_fe_config(shard_features=True, iters=15),
+                           mesh=make_mesh(4, 2)).fit(ds)
+    res_dp = GameEstimator(_fe_config(shard_features=False, iters=15),
+                           mesh=make_mesh()).fit(ds)
+    np.testing.assert_allclose(res_fs.objective_history,
+                               res_dp.objective_history, rtol=1e-5)
+    hist = res_fs.objective_history
+    assert hist[-1] < hist[0] if len(hist) > 1 else True
+    w = np.asarray(res_fs.model.coordinates["fixed"].glm.coefficients.means)
+    assert w.shape == (d,)
+
+
+def test_sparse_validate_and_roundtrip(rng, tmp_path):
+    """Validators and npz persistence must handle sparse shards (review
+    findings: np.asarray on csr gives a useless 0-d object array)."""
+    from photon_ml_tpu.data.game_data import (load_game_dataset,
+                                              save_game_dataset)
+    from photon_ml_tpu.data.validators import (DataValidationError,
+                                               validate_game_dataset)
+    x, y = _sparse_logistic(rng, n=80, d=30, nnz_per_row=5)
+    ds = build_game_dataset(y, {"global": x})
+    validate_game_dataset(ds, "logistic_regression", "full")
+    validate_game_dataset(ds, "logistic_regression", "sample")
+
+    p = str(tmp_path / "sp_ds.npz")
+    save_game_dataset(ds, p)
+    ds2 = load_game_dataset(p)
+    assert sp.issparse(ds2.feature_shards["global"])
+    assert (ds2.feature_shards["global"] != x).nnz == 0
+
+    bad = x.copy()
+    bad.data[2] = np.inf
+    ds3 = build_game_dataset(y, {"global": bad})
+    with pytest.raises(DataValidationError, match="non-finite feature"):
+        validate_game_dataset(ds3, "logistic_regression", "full")
