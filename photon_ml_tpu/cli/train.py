@@ -109,26 +109,33 @@ def _load_dataset(path: str, task: str):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(message)s",
-        stream=sys.stderr)
-    # stderr stays quiet unless --verbose; the persisted job log always
-    # captures INFO (reference: PhotonLogger writes the job log next to the
-    # job output on HDFS, photon-lib/.../util/PhotonLogger.scala:36-521)
-    for h in logging.getLogger().handlers:
-        h.setLevel(logging.INFO if args.verbose else logging.WARNING)
+    # stderr stays quiet unless --verbose (configured only when no host
+    # application has set up logging; basicConfig is a no-op otherwise and
+    # we must not touch a host's handlers or levels)
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO if args.verbose else logging.WARNING,
+            format="%(asctime)s %(message)s", stream=sys.stderr)
+    # persisted job log: the package logger always captures INFO into
+    # <output-dir>/training.log regardless of the host/root configuration
+    # (reference: PhotonLogger writes the job log next to the job output on
+    # HDFS, photon-lib/.../util/PhotonLogger.scala:36-521)
+    pkg_logger = logging.getLogger("photon_ml_tpu")
+    prev_level = pkg_logger.level
+    pkg_logger.setLevel(logging.INFO)
     os.makedirs(args.output_dir, exist_ok=True)
     _fh = logging.FileHandler(os.path.join(args.output_dir, "training.log"))
     _fh.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
     _fh.setLevel(logging.INFO)
-    logging.getLogger().addHandler(_fh)
+    pkg_logger.addHandler(_fh)
     log = logging.getLogger("photon_ml_tpu.train")
     try:
         return _run(args, log)
     finally:
         # main() is a callable API: don't leak this job's log handler into
         # the next in-process call, whatever stage raised
-        logging.getLogger().removeHandler(_fh)
+        pkg_logger.removeHandler(_fh)
+        pkg_logger.setLevel(prev_level)
         _fh.close()
 
 

@@ -104,6 +104,10 @@ def save_game_dataset(dataset: GameDataset, path: str) -> None:
         arrays["weights"] = dataset.weights
     for s, x in dataset.feature_shards.items():
         if _is_sparse(x):
+            if "::" in s:
+                raise ValueError(
+                    f"sparse shard name {s!r} may not contain '::' (it is "
+                    "the npz key delimiter)")
             csr = x.tocsr()
             arrays[f"spshard::{s}::data"] = csr.data
             arrays[f"spshard::{s}::indices"] = csr.indices

@@ -1,6 +1,6 @@
 from photon_ml_tpu.parallel.mesh import (  # noqa: F401
-    DATA_AXIS, FEATURE_AXIS, data_sharding, feature_sharding, make_mesh,
-    replicated, shard_leading,
+    DATA_AXIS, FEATURE_AXIS, data_sharding, feature_sharding,
+    initialize_multihost, make_mesh, replicated, shard_leading,
 )
 from photon_ml_tpu.parallel.fixed_effect import (  # noqa: F401
     fit_fixed_effect, pad_batch_to_mesh, score_fixed_effect, shard_objective,

@@ -56,6 +56,31 @@ def make_mesh(num_data: Optional[int] = None, num_feature: int = 1,
     return Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    num_feature: int = 1,
+) -> Mesh:
+    """Join a multi-host run and return the global mesh — the role of the
+    reference's cluster bring-up (SparkContextConfiguration.asYarnClient,
+    photon-api/.../SparkContextConfiguration.scala:110; its transport was
+    JVM sockets/Kryo, ours is ICI within a slice + DCN across slices).
+
+    Call once per host process before building datasets.  After
+    `jax.distributed.initialize`, `jax.devices()` is the GLOBAL device
+    list, so the returned mesh spans every host with "data" outermost:
+    per-slice gradient psums ride ICI and cross DCN once per reduction
+    (hierarchical, like the reference's treeAggregate depth-2).  All
+    arguments are optional on TPU pods, where they come from the
+    environment.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return make_mesh(num_feature=num_feature)
+
+
 def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Leading axis split over "data", rest replicated — batches and entity
     blocks."""
