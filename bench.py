@@ -142,7 +142,8 @@ def scipy_ref(task, x, y, l1=0.0, l2=0.0, bounds=None):
 # single-GLM solve benchmark (configs 1-3)
 # --------------------------------------------------------------------------
 
-def time_glm_solve(task, x_np, y_np, opt_cfg, reg, lam, reps=3):
+def time_glm_solve(task, x_np, y_np, opt_cfg, reg, lam, reps=3,
+                   feature_dtype=None):
     """jit solve() once, then time `reps` runs with distinct starts (the
     accelerator tunnel memoizes bit-identical executions)."""
     import jax
@@ -150,18 +151,23 @@ def time_glm_solve(task, x_np, y_np, opt_cfg, reg, lam, reps=3):
     from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
     from photon_ml_tpu.optim import solve
 
-    x, y = jnp.asarray(x_np), jnp.asarray(y_np)
+    x = (jnp.asarray(x_np) if feature_dtype is None
+         else jnp.asarray(x_np, feature_dtype))
+    y = jnp.asarray(y_np)
     obj = GLMObjective(TASK_LOSSES[task], x, y)
     run = jax.jit(lambda o, x0, lam_: solve(o, x0, opt_cfg, reg, lam_))
     d = x.shape[1]
-    lam_j = jnp.asarray(lam, x.dtype)
+    # solver state (coefficients, step sizes) stays float32 even when
+    # features are stored bf16 (speed mode)
+    state_dt = y.dtype if y.dtype in (jnp.float32, jnp.float64) else jnp.float32
+    lam_j = jnp.asarray(lam, state_dt)
     # the tunnel memoizes bit-identical executions ACROSS runs too, so the
     # start point must be unique per rep AND per process — a fixed salt
     # schedule re-served from cache once made this bench report absurd
     # numbers on its second invocation
     salt = (time.time_ns() % 997) * 1e-9
     t0 = time.perf_counter()
-    res = run(obj, jnp.full((d,), salt, x.dtype), lam_j)
+    res = run(obj, jnp.full((d,), salt, state_dt), lam_j)
     float(res.value)  # device->host readback: the only true sync point —
     # over the tunnel, block_until_ready returns before execution finishes
     compile_s = time.perf_counter() - t0
@@ -170,7 +176,7 @@ def time_glm_solve(task, x_np, y_np, opt_cfg, reg, lam, reps=3):
     # chain, so wall/reps is steady-state per-solve time with the tunnel's
     # ~60ms dispatch latency amortized — the shape a real lambda sweep has.
     t0 = time.perf_counter()
-    results = [run(obj, jnp.full((d,), 1e-6 * (r + 1) + salt, x.dtype),
+    results = [run(obj, jnp.full((d,), 1e-6 * (r + 1) + salt, state_dt),
                    lam_j) for r in range(reps)]
     for rr in results:
         float(rr.value)
@@ -178,19 +184,22 @@ def time_glm_solve(task, x_np, y_np, opt_cfg, reg, lam, reps=3):
     return results[-1], wall, compile_s
 
 
-def glm_entry(task, x_np, y_np, opt_cfg, reg, lam, l1, l2, label, reps=3):
+def glm_entry(task, x_np, y_np, opt_cfg, reg, lam, l1, l2, label, reps=3,
+              feature_dtype=None, data_seed=0):
     """One measured solve + float64 parity vs the scipy optimum.  The scipy
     optimum is deterministic in (label, data shape, lambdas) — the timing
     salt only perturbs OUR start point, never the data — so it is cached in
     bench_ref_cache.json alongside the GAME references."""
     res, wall, compile_s = time_glm_solve(task, x_np, y_np, opt_cfg, reg,
-                                          lam, reps)
+                                          lam, reps,
+                                          feature_dtype=feature_dtype)
     w = np.asarray(res.x, np.float64)
     x64, y64 = x_np.astype(np.float64), y_np.astype(np.float64)
     t0 = time.perf_counter()
     bounds = (None if opt_cfg.box_lower is None else
               (opt_cfg.box_lower[0], opt_cfg.box_upper[0]))
-    key = f"scipy:{label}:{x_np.shape[0]}x{x_np.shape[1]}:l1={l1}:l2={l2}"
+    key = (f"scipy:{label}:seed{data_seed}:{x_np.shape[0]}x{x_np.shape[1]}"
+           f":l1={l1}:l2={l2}")
     cached = _ref_cache_get_raw(key)
     if cached is not None:
         ref_nll = cached["ref_nll"]
@@ -224,13 +233,26 @@ def bench_config1():
         "logistic_regression", x, y,
         OptimizerConfig(max_iterations=100, tolerance=1e-9),
         RegularizationContext(RegularizationType.L2), lam, 0.0, lam,
-        "a1a_logistic_lbfgs_l2", reps=10)
+        "a1a_logistic_lbfgs_l2", reps=10, data_seed=42)
     # HBM traffic estimate: X read twice per fused value+grad pass
     bytes_moved = 2 * entry["n"] * entry["d"] * 4 * max(entry["iterations"], 1)
     gbps = bytes_moved / entry["wall_s"] / 1e9
     entry["achieved_gbps_est"] = round(gbps, 1)
     entry["hbm_frac_of_v5e_peak"] = round(gbps / V5E_HBM_GBPS, 3)
-    return [entry]
+
+    # speed mode: features stored bf16 (a1a features are 0/1, EXACT in
+    # bf16, so this is lossless here; solver state stays f32) — halves the
+    # bandwidth term of each pass
+    import jax.numpy as jnp
+    bf16 = glm_entry(
+        "logistic_regression", x, y,
+        OptimizerConfig(max_iterations=100, tolerance=1e-9),
+        RegularizationContext(RegularizationType.L2), lam, 0.0, lam,
+        "a1a_logistic_lbfgs_l2_bf16_features", reps=10,
+        feature_dtype=jnp.bfloat16, data_seed=42)
+    bf16["note"] = ("features stored bfloat16 (exact for a1a's binary "
+                    "features); solver state float32")
+    return [entry, bf16]
 
 
 def bench_config2():
@@ -248,11 +270,11 @@ def bench_config2():
                                    elastic_net_alpha=0.5)
         out.append(glm_entry(
             task, x, y, OptimizerConfig(max_iterations=200, tolerance=1e-10),
-            en, lam, 0.5 * lam, 0.5 * lam, f"a1a_{task_key}_owlqn_elastic_net"))
+            en, lam, 0.5 * lam, 0.5 * lam, f"a1a_{task_key}_owlqn_elastic_net", data_seed=52))
         l1 = RegularizationContext(RegularizationType.L1)
         out.append(glm_entry(
             task, x, y, OptimizerConfig(max_iterations=200, tolerance=1e-10),
-            l1, lam, lam, 0.0, f"a1a_{task_key}_owlqn_l1"))
+            l1, lam, lam, 0.0, f"a1a_{task_key}_owlqn_l1", data_seed=52))
         # TRON vs LBFGS on the smooth L2 problem (reference pairs TRON w/ L2)
         lam2 = 1.0
         l2 = RegularizationContext(RegularizationType.L2)
@@ -263,7 +285,8 @@ def bench_config2():
                                 max_iterations=(30 if opt == OptimizerType.TRON
                                                 else 200),
                                 tolerance=1e-10),
-                l2, lam2, 0.0, lam2, f"a1a_{task_key}_{opt.value}_l2"))
+                l2, lam2, 0.0, lam2, f"a1a_{task_key}_{opt.value}_l2",
+                data_seed=52))
     return out
 
 
@@ -281,7 +304,7 @@ def bench_config3():
     entry = glm_entry(
         "smoothed_hinge_loss_linear_svm", x, y, cfg,
         RegularizationContext(RegularizationType.L2), lam, 0.0, lam,
-        "a1a_smoothed_hinge_box_lbfgs_l2")
+        "a1a_smoothed_hinge_box_lbfgs_l2", data_seed=62)
     entry["box"] = [lo, hi]
     return [entry]
 

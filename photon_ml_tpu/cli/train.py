@@ -114,8 +114,13 @@ def main(argv=None) -> int:
     # we must not touch a host's handlers or levels)
     if not logging.getLogger().handlers:
         logging.basicConfig(
-            level=logging.INFO if args.verbose else logging.WARNING,
+            level=logging.INFO,
             format="%(asctime)s %(message)s", stream=sys.stderr)
+        # gate stderr on the HANDLER we just created: package INFO records
+        # propagate past the root logger's level, so the handler level is
+        # what actually keeps stderr quiet without --verbose
+        for h in logging.getLogger().handlers:
+            h.setLevel(logging.INFO if args.verbose else logging.WARNING)
     # persisted job log: the package logger always captures INFO into
     # <output-dir>/training.log regardless of the host/root configuration
     # (reference: PhotonLogger writes the job log next to the job output on
