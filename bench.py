@@ -198,7 +198,10 @@ def glm_entry(task, x_np, y_np, opt_cfg, reg, lam, l1, l2, label, reps=3,
     t0 = time.perf_counter()
     bounds = (None if opt_cfg.box_lower is None else
               (opt_cfg.box_lower[0], opt_cfg.box_upper[0]))
-    key = (f"scipy:{label}:seed{data_seed}:{x_np.shape[0]}x{x_np.shape[1]}"
+    # keyed by the PROBLEM (task/data/lambdas), not the display label:
+    # entries that share a problem (tron-vs-lbfgs, f32-vs-bf16) share the
+    # reference optimum
+    key = (f"scipy:{task}:seed{data_seed}:{x_np.shape[0]}x{x_np.shape[1]}"
            f":l1={l1}:l2={l2}")
     cached = _ref_cache_get_raw(key)
     if cached is not None:

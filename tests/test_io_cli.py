@@ -136,3 +136,35 @@ def test_cli_bad_args(cli_env):
                   "--output-dir", str(tmp / "x")])
     assert r.returncode != 0
     assert "invalid choice" in r.stderr
+
+
+def test_cli_sparse_train_and_score(tmp_path, rng):
+    """Sparse (CSR) feature shards flow through BOTH CLIs end-to-end on the
+    8-device mesh: npz round-trip, mesh training, model save, scoring with
+    evaluation (the wide-FE product path, VERDICT r2 item 4)."""
+    import scipy.sparse as sp
+
+    n, d = 600, 50
+    x = sp.random(n, d, density=0.2, format="csr", random_state=2)
+    w = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w)))).astype(np.float32)
+    ds = build_game_dataset(y, {"global": x})
+    train_p = str(tmp_path / "sp_train.npz")
+    save_game_dataset(ds, train_p)
+
+    out_dir = str(tmp_path / "sp_out")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", train_p, "--validation-data", train_p,
+                  "--output-dir", out_dir, "--reg-weights", "0.1",
+                  "--evaluators", "AUC"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["validation"]["AUC"] > 0.75
+
+    score_p = str(tmp_path / "sp_scores.npz")
+    r2 = _run_cli("photon_ml_tpu.cli.score",
+                  ["--model-dir", summary["output"], "--data", train_p,
+                   "--output", score_p, "--evaluators", "AUC"])
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    res = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert abs(res["evaluation"]["AUC"] - summary["validation"]["AUC"]) < 1e-6
