@@ -187,7 +187,7 @@ def time_glm_solve(task, x_np, y_np, opt_cfg, reg, lam, reps=3,
 def glm_entry(task, x_np, y_np, opt_cfg, reg, lam, l1, l2, label, reps=3,
               feature_dtype=None, data_seed=0):
     """One measured solve + float64 parity vs the scipy optimum.  The scipy
-    optimum is deterministic in (label, data shape, lambdas) — the timing
+    optimum is deterministic in (task, data seed, shape, lambdas, box) — the timing
     salt only perturbs OUR start point, never the data — so it is cached in
     bench_ref_cache.json alongside the GAME references."""
     res, wall, compile_s = time_glm_solve(task, x_np, y_np, opt_cfg, reg,
@@ -202,7 +202,7 @@ def glm_entry(task, x_np, y_np, opt_cfg, reg, lam, l1, l2, label, reps=3,
     # entries that share a problem (tron-vs-lbfgs, f32-vs-bf16) share the
     # reference optimum
     key = (f"scipy:{task}:seed{data_seed}:{x_np.shape[0]}x{x_np.shape[1]}"
-           f":l1={l1}:l2={l2}")
+           f":l1={l1}:l2={l2}:box={bounds}")
     cached = _ref_cache_get_raw(key)
     if cached is not None:
         ref_nll = cached["ref_nll"]

@@ -145,7 +145,8 @@ class GameTrainingConfig:
                     "tolerance": o.tolerance, "history": o.history,
                     "max_cg_iterations": o.max_cg_iterations,
                     "box_lower": list(o.box_lower) if o.box_lower else None,
-                    "box_upper": list(o.box_upper) if o.box_upper else None}
+                    "box_upper": list(o.box_upper) if o.box_upper else None,
+                    "track_coefficients": o.track_coefficients}
 
         def enc_glm(g: GLMOptimizationConfig):
             return {"optimizer": enc_opt(g.optimizer),
@@ -196,7 +197,8 @@ class GameTrainingConfig:
                 history=o.get("history", 10),
                 max_cg_iterations=o.get("max_cg_iterations", 20),
                 box_lower=tuple(o["box_lower"]) if o.get("box_lower") else None,
-                box_upper=tuple(o["box_upper"]) if o.get("box_upper") else None)
+                box_upper=tuple(o["box_upper"]) if o.get("box_upper") else None,
+                track_coefficients=o.get("track_coefficients", False))
 
         def dec_glm(g: dict) -> GLMOptimizationConfig:
             return GLMOptimizationConfig(
