@@ -151,7 +151,6 @@ def _run(args, log) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
 
-    from photon_ml_tpu.data.stats import BasicStatisticalSummary
     from photon_ml_tpu.game import GameEstimator, GameTrainingConfig
     from photon_ml_tpu.game.config import (FixedEffectCoordinateConfig,
                                            GLMOptimizationConfig)

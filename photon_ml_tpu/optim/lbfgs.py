@@ -32,7 +32,6 @@ Design notes
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
