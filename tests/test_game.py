@@ -201,3 +201,28 @@ def test_downsampling_fixed_effect(rng):
                       downsampling_rate=0.5))
     res = GameEstimator(cfg).fit(ds)
     assert np.isfinite(res.objective_history[-1])
+
+
+@pytest.mark.parametrize("norm", ["none", "scale_with_standard_deviation",
+                                  "scale_with_max_magnitude", "standardization"])
+def test_game_normalization_invariance(norm, rng):
+    """GAME-level normalization invariance (reference: GameEstimatorTest
+    normalization sweep, GameEstimatorTest.scala:125-180): the fixed-effect
+    coordinate trained in any normalized space must reach the same final
+    objective, because margins are invariant under the factor/shift
+    algebra."""
+    from photon_ml_tpu.ops.normalization import NormalizationType
+
+    ds, _ = _dataset(rng, task="logistic")
+    results = {}
+    for nt in ("none", norm):
+        cfg = GameTrainingConfig(
+            task_type="logistic_regression",
+            coordinates={"fixed": FixedEffectCoordinateConfig(
+                "global",
+                GLMOptimizationConfig(regularization=L2,
+                                      regularization_weight=0.0),
+                normalization=NormalizationType(nt))},
+            updating_sequence=["fixed"])
+        results[nt] = GameEstimator(cfg).fit(ds).objective_history[-1]
+    np.testing.assert_allclose(results[norm], results["none"], rtol=5e-5)
