@@ -168,3 +168,23 @@ def test_cli_sparse_train_and_score(tmp_path, rng):
     assert r2.returncode == 0, r2.stderr[-2000:]
     res = json.loads(r2.stdout.strip().splitlines()[-1])
     assert abs(res["evaluation"]["AUC"] - summary["validation"]["AUC"]) < 1e-6
+
+
+def test_cli_tuning_random_e2e(cli_env):
+    """--tuning random drives the search -> refit -> select-best pipeline
+    end-to-end (reference: Driver.runHyperparameterTuning,
+    cli/game/training/Driver.scala:337-373), with warm start."""
+    train_p, val_p, tmp = cli_env
+    out_dir = str(tmp / "out_tuning")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", train_p, "--validation-data", val_p,
+                  "--output-dir", out_dir, "--reg-weights", "1.0",
+                  "--evaluators", "AUC", "--tuning", "random",
+                  "--tuning-iterations", "2", "--warm-start"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    # 1 grid config + 2 tuning iterations, best-by-AUC selected and saved
+    assert summary["num_configs"] == 3
+    assert summary["validation"]["AUC"] > 0.6
+    loaded, cfg_back = load_game_model(summary["output"])
+    assert "fixed" in loaded.coordinates
