@@ -188,3 +188,18 @@ def test_cli_tuning_random_e2e(cli_env):
     assert summary["validation"]["AUC"] > 0.6
     loaded, cfg_back = load_game_model(summary["output"])
     assert "fixed" in loaded.coordinates
+
+
+def test_cli_tuning_bayesian_e2e(cli_env):
+    """--tuning bayesian: GP search seeded with the grid result."""
+    train_p, val_p, tmp = cli_env
+    out_dir = str(tmp / "out_bayes")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", train_p, "--validation-data", val_p,
+                  "--output-dir", out_dir, "--reg-weights", "1.0",
+                  "--evaluators", "AUC", "--tuning", "bayesian",
+                  "--tuning-iterations", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["num_configs"] == 3
+    assert summary["validation"]["AUC"] > 0.6
