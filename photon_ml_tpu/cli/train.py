@@ -82,6 +82,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "into <output-dir>/profile (the TPU-native "
                         "replacement for the reference's Timed/Spark event "
                         "log; view with TensorBoard or xprof)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="persist the model after every outer coordinate-"
+                        "descent iteration and resume from the latest "
+                        "record on restart (GAME --config path only; the "
+                        "reference restarts failed jobs from scratch)")
     return p
 
 
@@ -209,8 +214,12 @@ def _run(args, log) -> int:
             with open(args.config) as f:
                 config = GameTrainingConfig.from_json(f.read())
             results = [GameEstimator(config, mesh=mesh, emitter=emitter).fit(
-                train, val, evaluator_specs)]
+                train, val, evaluator_specs,
+                checkpoint_dir=args.checkpoint_dir)]
         else:
+            if args.checkpoint_dir:
+                log.warning("--checkpoint-dir applies to the GAME --config "
+                            "path only; ignoring for the lambda-sweep path")
             # legacy single-GLM path: one FE coordinate, lambda sweep, best by
             # first validation evaluator (reference: Driver stage machine +
             # ModelSelection)

@@ -90,6 +90,105 @@ class CoordinateDescentResult:
         return sum(t.iterations for t in self.trackers.values())
 
 
+@dataclasses.dataclass
+class CheckpointState:
+    """One resumable record (no reference equivalent — a failed Spark
+    driver restarts the job from scratch, SURVEY §5.3)."""
+
+    completed_iterations: int
+    initial_models: Dict[str, object]
+    objective_history: List[float]
+    validation_history: Dict[str, List[float]]
+    best_models: Optional[Dict[str, object]]    # None = same as latest
+    best_metric: Optional[float]
+
+
+def _write_checkpoint(directory: str, iteration: int, model: GameModel,
+                      objective_history: List[float],
+                      validation_history: Dict[str, List[float]],
+                      best_model: GameModel,
+                      best_metric: Optional[float]) -> None:
+    """Persist the latest model + the best-so-far model + a state record
+    after an outer iteration.
+
+    Layout: {dir}/iter-{k:04d}/ and {dir}/best-{k:04d}/ (save_game_model
+    format) + {dir}/state.json.  The state file is replaced ATOMICALLY and
+    LAST, so a crash mid-save leaves the previous record intact; the model
+    directories a superseded record pointed at are pruned afterwards."""
+    import json
+    import os
+    import shutil
+
+    from photon_ml_tpu.models.io import save_game_model
+
+    try:
+        with open(os.path.join(directory, "state.json")) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        prev = None
+
+    path = os.path.join(directory, f"iter-{iteration:04d}")
+    save_game_model(model, path)
+    # the best-so-far model is only meaningful when validation tracking is
+    # active; without it the final model IS the result
+    best_path = None
+    if best_metric is not None:
+        best_path = os.path.join(directory, f"best-{iteration:04d}")
+        save_game_model(best_model, best_path)
+    state = {"completed_iterations": iteration + 1,
+             "model_dir": path,
+             "best_model_dir": best_path,
+             "best_metric": best_metric,
+             "objective_history": objective_history,
+             "validation_history": validation_history}
+    tmp = os.path.join(directory, "state.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, os.path.join(directory, "state.json"))
+    # prune the dirs the superseded record referenced (only the latest
+    # record is ever resumed from)
+    if prev is not None:
+        for key in ("model_dir", "best_model_dir"):
+            old = prev.get(key)
+            if old and old not in (path, best_path) and os.path.isdir(old):
+                shutil.rmtree(old, ignore_errors=True)
+    logger.info("checkpoint: iteration %d saved to %s", iteration, path)
+
+
+def read_checkpoint(directory: str) -> Optional[CheckpointState]:
+    """The resume half of the checkpoint flow.  An unreadable or partial
+    state file is treated as no-checkpoint (the write path replaces
+    state.json atomically, so this only happens for foreign/corrupt
+    files — better to retrain than to crash the job permanently)."""
+    import json
+    import os
+
+    from photon_ml_tpu.models.io import load_game_model
+
+    state_path = os.path.join(directory, "state.json")
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+        model, _ = load_game_model(state["model_dir"])
+        best = None
+        if state.get("best_model_dir"):
+            best_model, _ = load_game_model(state["best_model_dir"])
+            best = dict(best_model.coordinates)
+    except (OSError, ValueError, KeyError) as e:
+        if os.path.exists(state_path):
+            logger.warning("checkpoint at %s unreadable (%s); starting fresh",
+                           directory, e)
+        return None
+    return CheckpointState(
+        completed_iterations=int(state["completed_iterations"]),
+        initial_models=dict(model.coordinates),
+        objective_history=list(state["objective_history"]),
+        validation_history={k: list(v) for k, v in
+                            state.get("validation_history", {}).items()},
+        best_models=best,
+        best_metric=state.get("best_metric"))
+
+
 def run_coordinate_descent(
     coordinates: Dict[str, Coordinate],
     updating_sequence: Sequence[str],
@@ -99,8 +198,17 @@ def run_coordinate_descent(
     validation_dataset: Optional[GameDataset] = None,
     validation_specs: Sequence[ValidationSpec] = (),
     initial_models: Optional[Dict[str, object]] = None,
+    checkpoint_dir: Optional[str] = None,
+    resume: Optional[CheckpointState] = None,
 ) -> CoordinateDescentResult:
-    """reference: CoordinateDescent.run/optimize (scala:57-385)."""
+    """reference: CoordinateDescent.run/optimize (scala:57-385).
+
+    `checkpoint_dir` persists the latest + best-so-far models and a state
+    record after every OUTER iteration; `resume` (a CheckpointState from
+    read_checkpoint) continues from such a record — a capability the
+    reference does NOT have (driver failure there restarts the job from
+    scratch, SURVEY §5.3).  Use GameEstimator.fit(checkpoint_dir=...) for
+    the integrated save-and-resume flow."""
     loss = TASK_LOSSES[task_type]
     labels = jnp.asarray(dataset.response)
     weights = None if dataset.weights is None else jnp.asarray(dataset.weights)
@@ -115,19 +223,31 @@ def run_coordinate_descent(
                        for c in models)
         return data_term + reg_term
 
-    # init (reference: CoordinateDescent.run line 57-96)
+    # init (reference: CoordinateDescent.run line 57-96); a resume record
+    # overrides the initial models and restores histories + best tracking
+    start_iteration = 0
+    if resume is not None:
+        start_iteration = min(resume.completed_iterations, num_iterations)
+        initial_models = resume.initial_models
     models = {name: (initial_models or {}).get(name) or
               coordinates[name].initial_model() for name in updating_sequence}
     scores = {name: coordinates[name].score(models[name])
               for name in updating_sequence}
     total = sum(scores.values(), jnp.zeros(dataset.num_rows))
 
-    objective_history: List[float] = []
-    validation_history: Dict[str, List[float]] = {s.name: [] for s in validation_specs}
+    objective_history: List[float] = list(
+        resume.objective_history if resume is not None else [])
+    validation_history: Dict[str, List[float]] = {
+        s.name: list((resume.validation_history if resume is not None
+                      else {}).get(s.name, [])) for s in validation_specs}
     timings: Dict[str, float] = {}
     trackers: Dict[str, TrackerSummary] = {}
     best_model = GameModel(dict(models), task_type)
     best_metric: Optional[float] = None
+    if resume is not None and resume.best_metric is not None:
+        best_metric = resume.best_metric
+        if resume.best_models is not None:
+            best_model = GameModel(dict(resume.best_models), task_type)
 
     # per-coordinate validation scores, updated incrementally (only the
     # changed coordinate is rescored — same algebra as the training side)
@@ -138,7 +258,7 @@ def run_coordinate_descent(
             name: models[name].score_dataset(validation_dataset)
             for name in updating_sequence}
 
-    for it in range(num_iterations):
+    for it in range(start_iteration, num_iterations):
         for name in updating_sequence:
             t0 = time.perf_counter()
             coord = coordinates[name]
@@ -168,6 +288,12 @@ def run_coordinate_descent(
                         if best_metric is None or spec.evaluator.better_than(v, best_metric):
                             best_metric = v
                             best_model = GameModel(dict(models), task_type)
+
+        if checkpoint_dir is not None:
+            _write_checkpoint(checkpoint_dir, it,
+                              GameModel(dict(models), task_type),
+                              objective_history, validation_history,
+                              best_model, best_metric)
 
     final = GameModel(dict(models), task_type)
     if validation_dataset is None or not validation_specs:

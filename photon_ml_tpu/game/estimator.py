@@ -94,12 +94,18 @@ class GameEstimator:
         validation_dataset: Optional[GameDataset] = None,
         evaluator_specs: Optional[Sequence[str]] = None,
         initial_model: Optional[GameModel] = None,
+        checkpoint_dir: Optional[str] = None,
     ) -> GameResult:
         """reference: GameEstimator.fit (GameEstimator.scala:175).
 
         `initial_model` warm-starts every coordinate it covers (reference:
         GameTrainingParams.useWarmStart — "the previous optimal model is used
-        to initialize the next model")."""
+        to initialize the next model").
+
+        `checkpoint_dir` persists the model after every outer coordinate-
+        descent iteration and RESUMES from the latest record when one is
+        already present — the reference has no mid-training recovery (a
+        failed Spark driver restarts the job from scratch, SURVEY §5.3)."""
         if self.emitter is not None:
             self.emitter.send_event(TrainingStartEvent(time.time()))
         coords = self._build_coordinates(dataset)
@@ -107,11 +113,16 @@ class GameEstimator:
                  if validation_dataset is not None else [])
         initial_models = (dict(initial_model.coordinates)
                           if initial_model is not None else None)
+        resume = None
+        if checkpoint_dir is not None:
+            from photon_ml_tpu.game.coordinate_descent import read_checkpoint
+            resume = read_checkpoint(checkpoint_dir)
         descent = run_coordinate_descent(
             coords, self.config.updating_sequence,
             self.config.num_outer_iterations, dataset, self.config.task_type,
             validation_dataset=validation_dataset, validation_specs=specs,
-            initial_models=initial_models)
+            initial_models=initial_models,
+            checkpoint_dir=checkpoint_dir, resume=resume)
         validation = {name: hist[-1] for name, hist in
                       descent.validation_history.items() if hist}
         if self.emitter is not None:
