@@ -85,8 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None,
                    help="persist the model after every outer coordinate-"
                         "descent iteration and resume from the latest "
-                        "record on restart (GAME --config path only; the "
-                        "reference restarts failed jobs from scratch)")
+                        "record on restart; sweeps checkpoint per grid "
+                        "combo (the reference restarts failed jobs from "
+                        "scratch)")
     return p
 
 
@@ -217,9 +218,6 @@ def _run(args, log) -> int:
                 train, val, evaluator_specs,
                 checkpoint_dir=args.checkpoint_dir)]
         else:
-            if args.checkpoint_dir:
-                log.warning("--checkpoint-dir applies to the GAME --config "
-                            "path only; ignoring for the lambda-sweep path")
             # legacy single-GLM path: one FE coordinate, lambda sweep, best by
             # first validation evaluator (reference: Driver stage machine +
             # ModelSelection)
@@ -239,7 +237,8 @@ def _run(args, log) -> int:
                     normalization=NormalizationType(args.normalization))},
                 updating_sequence=["fixed"])
             results = GameEstimator(config, mesh=mesh, emitter=emitter).fit_grid(
-                train, grid, val, evaluator_specs, warm_start=args.warm_start)
+                train, grid, val, evaluator_specs, warm_start=args.warm_start,
+                checkpoint_dir=args.checkpoint_dir)
 
         if args.tuning != "none":
             # reference: Driver.runHyperparameterTuning — searcher seeded with

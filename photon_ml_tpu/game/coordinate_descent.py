@@ -107,7 +107,8 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
                       objective_history: List[float],
                       validation_history: Dict[str, List[float]],
                       best_model: GameModel,
-                      best_metric: Optional[float]) -> None:
+                      best_metric: Optional[float],
+                      fingerprint: Optional[str]) -> None:
     """Persist the latest model + the best-so-far model + a state record
     after an outer iteration.
 
@@ -139,6 +140,7 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
              "model_dir": path,
              "best_model_dir": best_path,
              "best_metric": best_metric,
+             "config_fingerprint": fingerprint,
              "objective_history": objective_history,
              "validation_history": validation_history}
     tmp = os.path.join(directory, "state.json.tmp")
@@ -155,11 +157,19 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
     logger.info("checkpoint: iteration %d saved to %s", iteration, path)
 
 
-def read_checkpoint(directory: str) -> Optional[CheckpointState]:
+def read_checkpoint(directory: str,
+                    fingerprint: Optional[str] = None
+                    ) -> Optional[CheckpointState]:
     """The resume half of the checkpoint flow.  An unreadable or partial
     state file is treated as no-checkpoint (the write path replaces
     state.json atomically, so this only happens for foreign/corrupt
-    files — better to retrain than to crash the job permanently)."""
+    files — better to retrain than to crash the job permanently).
+
+    `fingerprint` guards against resuming under a CHANGED configuration: a
+    record written with a different coordinate/optimization config (outer
+    iteration count excluded — raising it is the legitimate resume use) is
+    rejected with a warning rather than silently returning a model trained
+    under different settings."""
     import json
     import os
 
@@ -169,6 +179,14 @@ def read_checkpoint(directory: str) -> Optional[CheckpointState]:
     try:
         with open(state_path) as f:
             state = json.load(f)
+        recorded = state.get("config_fingerprint")
+        if fingerprint is not None and recorded is not None \
+                and recorded != fingerprint:
+            logger.warning(
+                "checkpoint at %s was written under a different training "
+                "configuration (fingerprint %s != %s); starting fresh",
+                directory, recorded, fingerprint)
+            return None
         model, _ = load_game_model(state["model_dir"])
         best = None
         if state.get("best_model_dir"):
@@ -200,6 +218,7 @@ def run_coordinate_descent(
     initial_models: Optional[Dict[str, object]] = None,
     checkpoint_dir: Optional[str] = None,
     resume: Optional[CheckpointState] = None,
+    checkpoint_fingerprint: Optional[str] = None,
 ) -> CoordinateDescentResult:
     """reference: CoordinateDescent.run/optimize (scala:57-385).
 
@@ -293,7 +312,28 @@ def run_coordinate_descent(
             _write_checkpoint(checkpoint_dir, it,
                               GameModel(dict(models), task_type),
                               objective_history, validation_history,
-                              best_model, best_metric)
+                              best_model, best_metric,
+                              checkpoint_fingerprint)
+
+    if (do_validation and resume is not None
+            and start_iteration >= num_iterations
+            and any(not validation_history[s.name] for s in validation_specs)):
+        # resumed past the last iteration (the checkpoint already covers the
+        # whole fit) and the record lacks metrics for some spec (e.g. the
+        # original fit ran without validation): evaluate the restored model
+        # once for those specs — callers like select_best_result need them.
+        # Specs whose restored history is already complete are left alone.
+        val_scores = sum(val_scores_by_coord.values(),
+                         jnp.zeros(validation_dataset.num_rows))
+        for k, spec in enumerate(validation_specs):
+            if validation_history[spec.name]:
+                continue
+            v = spec.evaluate(validation_dataset, val_scores)
+            validation_history[spec.name].append(v)
+            if k == 0 and (best_metric is None
+                           or spec.evaluator.better_than(v, best_metric)):
+                best_metric = v
+                best_model = GameModel(dict(models), task_type)
 
     final = GameModel(dict(models), task_type)
     if validation_dataset is None or not validation_specs:

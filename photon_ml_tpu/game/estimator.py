@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -77,6 +78,17 @@ class GameEstimator:
                     seed=self.config.seed)
         return coords
 
+    def _config_fingerprint(self) -> str:
+        """Identity of everything that makes a checkpoint resumable, i.e.
+        the full training config EXCEPT the outer iteration count (raising
+        it and resuming is the intended use)."""
+        import hashlib
+        import json
+        d = self.config.to_dict()
+        d.pop("num_outer_iterations", None)
+        return hashlib.sha256(
+            json.dumps(d, sort_keys=True).encode()).hexdigest()[:16]
+
     def _validation_specs(self, evaluator_specs: Optional[Sequence[str]]
                           ) -> List[ValidationSpec]:
         if not evaluator_specs:
@@ -114,15 +126,18 @@ class GameEstimator:
         initial_models = (dict(initial_model.coordinates)
                           if initial_model is not None else None)
         resume = None
+        fingerprint = None
         if checkpoint_dir is not None:
             from photon_ml_tpu.game.coordinate_descent import read_checkpoint
-            resume = read_checkpoint(checkpoint_dir)
+            fingerprint = self._config_fingerprint()
+            resume = read_checkpoint(checkpoint_dir, fingerprint)
         descent = run_coordinate_descent(
             coords, self.config.updating_sequence,
             self.config.num_outer_iterations, dataset, self.config.task_type,
             validation_dataset=validation_dataset, validation_specs=specs,
             initial_models=initial_models,
-            checkpoint_dir=checkpoint_dir, resume=resume)
+            checkpoint_dir=checkpoint_dir, resume=resume,
+            checkpoint_fingerprint=fingerprint)
         validation = {name: hist[-1] for name, hist in
                       descent.validation_history.items() if hist}
         if self.emitter is not None:
@@ -145,6 +160,7 @@ class GameEstimator:
         validation_dataset: Optional[GameDataset] = None,
         evaluator_specs: Optional[Sequence[str]] = None,
         warm_start: bool = False,
+        checkpoint_dir: Optional[str] = None,
     ) -> List[GameResult]:
         """Sweep per-coordinate optimization configs (cartesian product),
         reference: GameTrainingParams.getAllModelConfigs + train-per-config
@@ -153,19 +169,27 @@ class GameEstimator:
         With `warm_start`, each combo is initialized from the previous
         combo's trained model (reference: useWarmStart; ModelTraining.scala:
         160-196 does the same across the lambda sweep — pass the grid
-        strongest-regularization-first to match)."""
+        strongest-regularization-first to match).
+
+        With `checkpoint_dir`, each combo checkpoints under its own
+        `combo-NNN` subdirectory; re-running an interrupted sweep resumes
+        the partial combo mid-descent and replays completed combos as
+        instant no-ops (their checkpoints already cover every iteration)."""
         names = list(grid)
         results: List[GameResult] = []
         previous: Optional[GameModel] = None
-        for combo in itertools.product(*(grid[n] for n in names)):
+        for i, combo in enumerate(itertools.product(*(grid[n] for n in names))):
             coords = dict(self.config.coordinates)
             for n, opt in zip(names, combo):
                 coords[n] = dataclasses.replace(coords[n], optimization=opt)
             cfg = dataclasses.replace(self.config, coordinates=coords)
             sub = GameEstimator(cfg, self.mesh, emitter=self.emitter)
+            combo_ckpt = (None if checkpoint_dir is None else
+                          os.path.join(checkpoint_dir, f"combo-{i:03d}"))
             results.append(sub.fit(
                 dataset, validation_dataset, evaluator_specs,
-                initial_model=previous if warm_start else None))
+                initial_model=previous if warm_start else None,
+                checkpoint_dir=combo_ckpt))
             previous = results[-1].model
         return results
 

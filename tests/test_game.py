@@ -286,3 +286,48 @@ def test_checkpoint_resume_with_validation_preserves_best(rng, tmp_path):
         f.write("{not json")
     fresh = GameEstimator(cfg1).fit(train, val, checkpoint_dir=ckpt)
     assert len(fresh.objective_history) == 2
+
+
+def test_grid_checkpoint_replays_as_noop(rng, tmp_path):
+    """A checkpointed sweep re-run replays completed combos instantly with
+    identical results (per-combo checkpoint subdirectories)."""
+    ds, _ = _dataset(rng, n=600)
+    rows = np.arange(ds.num_rows)
+    train, val = ds.subset(rows[:450]), ds.subset(rows[450:])
+    grid = {"perUser": [
+        GLMOptimizationConfig(regularization=L2, regularization_weight=w)
+        for w in (100.0, 1.0)]}
+    ckpt = str(tmp_path / "sweep")
+    est = GameEstimator(_config(iters=1))
+    first = est.fit_grid(train, grid, val, checkpoint_dir=ckpt)
+    replay = est.fit_grid(train, grid, val, checkpoint_dir=ckpt)
+    assert len(replay) == len(first) == 2
+    for a, b in zip(first, replay):
+        np.testing.assert_allclose(b.objective_history, a.objective_history,
+                                   rtol=1e-7)
+        np.testing.assert_allclose(b.validation["RMSE"],
+                                   a.validation["RMSE"], rtol=1e-6)
+        # the replayed combo ran no solves at all
+        assert b.descent.total_iterations() == 0
+    best_a = select_best_result(first)
+    best_b = select_best_result(replay)
+    assert best_a.validation["RMSE"] == pytest.approx(
+        best_b.validation["RMSE"], rel=1e-6)
+
+
+def test_checkpoint_rejects_changed_config(rng, tmp_path):
+    """A checkpoint written under a different optimization config must not
+    be resumed (it would return a model trained under other settings
+    silently); the fit retrains fresh with a warning instead."""
+    ds, _ = _dataset(rng, task="logistic")
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator(_config(task="logistic_regression", iters=1)).fit(
+        ds, checkpoint_dir=ckpt)
+
+    changed = _config(task="logistic_regression", iters=1,
+                      re_opt=GLMOptimizationConfig(
+                          regularization=L2, regularization_weight=50.0))
+    res = GameEstimator(changed).fit(ds, checkpoint_dir=ckpt)
+    # fresh fit: full history (2 coordinate updates), not a no-op replay
+    assert len(res.objective_history) == 2
+    assert res.descent.total_iterations() > 0
