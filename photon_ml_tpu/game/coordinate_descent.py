@@ -134,8 +134,15 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
     # active; without it the final model IS the result
     best_path = None
     if best_metric is not None:
-        best_path = os.path.join(directory, f"best-{iteration:04d}")
-        save_game_model(best_model, best_path)
+        if (prev is not None and prev.get("best_metric") == best_metric
+                and prev.get("best_model_dir")
+                and os.path.isdir(prev["best_model_dir"])):
+            # best unchanged since the previous record: point at the
+            # existing directory instead of re-serializing the model
+            best_path = prev["best_model_dir"]
+        else:
+            best_path = os.path.join(directory, f"best-{iteration:04d}")
+            save_game_model(best_model, best_path)
     state = {"completed_iterations": iteration + 1,
              "model_dir": path,
              "best_model_dir": best_path,
@@ -192,19 +199,19 @@ def read_checkpoint(directory: str,
         if state.get("best_model_dir"):
             best_model, _ = load_game_model(state["best_model_dir"])
             best = dict(best_model.coordinates)
+        return CheckpointState(
+            completed_iterations=int(state["completed_iterations"]),
+            initial_models=dict(model.coordinates),
+            objective_history=list(state["objective_history"]),
+            validation_history={k: list(v) for k, v in
+                                state.get("validation_history", {}).items()},
+            best_models=best,
+            best_metric=state.get("best_metric"))
     except (OSError, ValueError, KeyError) as e:
         if os.path.exists(state_path):
             logger.warning("checkpoint at %s unreadable (%s); starting fresh",
                            directory, e)
         return None
-    return CheckpointState(
-        completed_iterations=int(state["completed_iterations"]),
-        initial_models=dict(model.coordinates),
-        objective_history=list(state["objective_history"]),
-        validation_history={k: list(v) for k, v in
-                            state.get("validation_history", {}).items()},
-        best_models=best,
-        best_metric=state.get("best_metric"))
 
 
 def run_coordinate_descent(
@@ -245,8 +252,18 @@ def run_coordinate_descent(
     # init (reference: CoordinateDescent.run line 57-96); a resume record
     # overrides the initial models and restores histories + best tracking
     start_iteration = 0
+    if resume is not None and resume.completed_iterations > num_iterations:
+        logger.warning(
+            "checkpoint covers %d outer iterations but this fit requests "
+            "only %d; ignoring the checkpoint (delete it to silence this)",
+            resume.completed_iterations, num_iterations)
+        resume = None
     if resume is not None:
         start_iteration = min(resume.completed_iterations, num_iterations)
+        if initial_models:
+            logger.warning("resuming from a checkpoint: the provided "
+                           "initial/warm-start models are superseded by the "
+                           "checkpointed models")
         initial_models = resume.initial_models
     models = {name: (initial_models or {}).get(name) or
               coordinates[name].initial_model() for name in updating_sequence}

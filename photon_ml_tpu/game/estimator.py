@@ -78,14 +78,18 @@ class GameEstimator:
                     seed=self.config.seed)
         return coords
 
-    def _config_fingerprint(self) -> str:
-        """Identity of everything that makes a checkpoint resumable, i.e.
-        the full training config EXCEPT the outer iteration count (raising
-        it and resuming is the intended use)."""
+    def _config_fingerprint(
+            self, evaluator_specs: Optional[Sequence[str]]) -> str:
+        """Identity of everything that makes a checkpoint resumable: the
+        full training config EXCEPT the outer iteration count (raising it
+        and resuming is the intended use), PLUS the validation evaluator
+        specs — the checkpointed best_metric is only comparable under the
+        same first evaluator."""
         import hashlib
         import json
         d = self.config.to_dict()
         d.pop("num_outer_iterations", None)
+        d["__evaluator_specs__"] = list(evaluator_specs or [])
         return hashlib.sha256(
             json.dumps(d, sort_keys=True).encode()).hexdigest()[:16]
 
@@ -129,7 +133,7 @@ class GameEstimator:
         fingerprint = None
         if checkpoint_dir is not None:
             from photon_ml_tpu.game.coordinate_descent import read_checkpoint
-            fingerprint = self._config_fingerprint()
+            fingerprint = self._config_fingerprint(evaluator_specs)
             resume = read_checkpoint(checkpoint_dir, fingerprint)
         descent = run_coordinate_descent(
             coords, self.config.updating_sequence,

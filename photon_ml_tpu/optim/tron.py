@@ -39,10 +39,10 @@ _MAX_FAILURES = 5
 def _truncated_cg(hess_vec: HessVec, x, g, delta, max_cg: int):
     """Approximately solve H s = -g within |s| <= delta.
 
-    Returns (s, hit_boundary).  Stops on residual tolerance, boundary
-    intersection (step extended to the sphere), or negative curvature
-    (step extended to the sphere along the current direction).
-    reference behavior: TRON.scala:279-339."""
+    Returns (s, sHs, hit_boundary, cg_iterations).  Stops on residual
+    tolerance, boundary intersection (step extended to the sphere), or
+    negative curvature (step extended to the sphere along the current
+    direction).  reference behavior: TRON.scala:279-339."""
     dtype = x.dtype
     s0 = jnp.zeros_like(x)
     r0 = -g
@@ -95,7 +95,7 @@ def _truncated_cg(hess_vec: HessVec, x, g, delta, max_cg: int):
     init = _C(i=jnp.asarray(0, jnp.int32), s=s0, r=r0, d=d0, hs=jnp.zeros_like(x),
               rr=rr0, done=rr0 <= tol * tol, boundary=jnp.asarray(False))
     out = lax.while_loop(cond, body, init)
-    return out.s, jnp.dot(out.s, out.hs), out.boundary
+    return out.s, jnp.dot(out.s, out.hs), out.boundary, out.i
 
 
 def tron(
@@ -126,6 +126,7 @@ def tron(
         loss_hist: jax.Array
         gnorm_hist: jax.Array
         coef_hist: "jax.Array | None"
+        hv_total: jax.Array
 
     nan = jnp.asarray(jnp.nan, dtype)
     init = _S(
@@ -139,13 +140,15 @@ def tron(
         gnorm_hist=jnp.full((max_iterations + 1,), nan).at[0].set(gnorm0),
         coef_hist=(jnp.full((max_iterations + 1, x0.shape[-1]), nan)
                    .at[0].set(x0) if track_coefficients else None),
+        hv_total=jnp.asarray(0, jnp.int32),
     )
 
     def cond(st: _S):
         return (st.k < max_iterations) & (st.reason == ConvergenceReason.NOT_CONVERGED)
 
     def body(st: _S) -> _S:
-        s, shs, hit = _truncated_cg(hess_vec, st.x, st.g, st.delta, max_cg_iterations)
+        s, shs, hit, cg_n = _truncated_cg(hess_vec, st.x, st.g, st.delta,
+                                          max_cg_iterations)
         gs = jnp.dot(st.g, s)
         pred = -(gs + 0.5 * shs)                      # predicted reduction
         x_try = st.x + s
@@ -182,7 +185,8 @@ def tron(
                   loss_hist=st.loss_hist.at[k].set(f_new),
                   gnorm_hist=st.gnorm_hist.at[k].set(gnorm_new),
                   coef_hist=(None if st.coef_hist is None
-                             else st.coef_hist.at[k].set(x_new)))
+                             else st.coef_hist.at[k].set(x_new)),
+                  hv_total=st.hv_total + cg_n)
 
     st = lax.while_loop(cond, body, init)
     reason = jnp.where(st.reason == ConvergenceReason.NOT_CONVERGED,
@@ -191,4 +195,5 @@ def tron(
     return SolveResult(x=st.x, value=st.f, gradient_norm=st.gnorm,
                        iterations=st.k, reason=reason,
                        loss_history=st.loss_hist, gnorm_history=st.gnorm_hist,
-                       coefficient_history=st.coef_hist)
+                       coefficient_history=st.coef_hist,
+                       hv_count=st.hv_total)

@@ -51,6 +51,10 @@ class SolveResult(NamedTuple):
     # track_coefficients (reference: ModelTracker per-iteration models,
     # photon-api/.../supervised/model/ModelTracker.scala); None otherwise
     coefficient_history: "jax.Array | None" = None
+    # TRON only: total Hessian-vector products across all inner CG steps
+    # (each is a full data pass — the honest work count for throughput
+    # accounting; the reference pays one treeAggregate per Hv, TRON.scala:301)
+    hv_count: "jax.Array | None" = None
 
     @property
     def converged(self) -> jax.Array:

@@ -331,3 +331,38 @@ def test_checkpoint_rejects_changed_config(rng, tmp_path):
     # fresh fit: full history (2 coordinate updates), not a no-op replay
     assert len(res.objective_history) == 2
     assert res.descent.total_iterations() > 0
+
+
+def test_checkpoint_edge_cases(rng, tmp_path):
+    """Over-complete checkpoints are ignored with a warning (not silently
+    returned as an over-trained 'shorter' fit); changed evaluator specs
+    reject the record; partial-but-parseable state falls back to fresh."""
+    import json
+
+    ds, _ = _dataset(rng, task="logistic")
+    rows = np.arange(ds.num_rows)
+    train, val = ds.subset(rows[:900]), ds.subset(rows[900:])
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator(_config(task="logistic_regression", iters=2)).fit(
+        train, val, checkpoint_dir=ckpt)
+
+    # fewer iterations than the checkpoint covers -> fresh 1-iteration fit
+    res = GameEstimator(_config(task="logistic_regression", iters=1)).fit(
+        train, val, checkpoint_dir=ckpt)
+    assert len(res.objective_history) == 2  # 1 iter x 2 coordinates
+
+    # different evaluator specs -> fingerprint mismatch -> fresh fit
+    res2 = GameEstimator(_config(task="logistic_regression", iters=1)).fit(
+        train, val, evaluator_specs=["LOGISTIC_LOSS"], checkpoint_dir=ckpt)
+    assert res2.descent.total_iterations() > 0
+
+    # parseable state missing required keys -> fresh start, no crash
+    state_path = tmp_path / "ckpt" / "state.json"
+    with open(state_path) as f:
+        st = json.load(f)
+    del st["completed_iterations"]
+    with open(state_path, "w") as f:
+        json.dump(st, f)
+    res3 = GameEstimator(_config(task="logistic_regression", iters=1)).fit(
+        train, val, checkpoint_dir=ckpt)
+    assert res3.descent.total_iterations() > 0
