@@ -200,9 +200,11 @@ def glm_entry(task, x_np, y_np, opt_cfg, reg, lam, l1, l2, label, reps=3,
               (opt_cfg.box_lower[0], opt_cfg.box_upper[0]))
     # keyed by the PROBLEM (task/data/lambdas), not the display label:
     # entries that share a problem (tron-vs-lbfgs, f32-vs-bf16) share the
-    # reference optimum
+    # reference optimum.  The data fingerprint makes a generator change
+    # invalidate the entry instead of silently reusing a stale optimum.
     key = (f"scipy:{task}:seed{data_seed}:{x_np.shape[0]}x{x_np.shape[1]}"
-           f":l1={l1}:l2={l2}:box={bounds}")
+           f":l1={l1}:l2={l2}:box={bounds}"
+           f":fp={_data_fingerprint(x_np, y_np)}")
     cached = _ref_cache_get_raw(key)
     if cached is not None:
         ref_nll = cached["ref_nll"]
@@ -411,8 +413,33 @@ _REF_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_ref_cache.json")
 
 
+_FP_CACHE: dict = {}
+
+
+def _data_fingerprint(x_np, y_np) -> str:
+    """Short content hash of a generated (x, y) pair, memoized per array
+    identity (the bench reuses one dataset across several entries)."""
+    import hashlib
+
+    from photon_ml_tpu.data.synthetic_bench import GENERATOR_VERSION
+    memo_key = (id(x_np), id(y_np))
+    if memo_key not in _FP_CACHE:
+        h = hashlib.blake2b(digest_size=8)
+        h.update(np.ascontiguousarray(x_np).data)
+        h.update(np.ascontiguousarray(y_np).data)
+        # pin the arrays: an id()-keyed memo without a reference would hand a
+        # recycled address the previous dataset's fingerprint
+        _FP_CACHE[memo_key] = (x_np, y_np,
+                               f"{GENERATOR_VERSION}-{h.hexdigest()}")
+    return _FP_CACHE[memo_key][2]
+
+
 def _ref_cache_key(scale, n_rows, seed, full) -> str:
-    return f"{scale}:{n_rows}:{seed}:{'full' if full else 'glmix'}"
+    # the GAME data is generated inside run_game, so the key carries the
+    # generator version (bumped on any generator change) instead of a hash
+    from photon_ml_tpu.data.synthetic_bench import GENERATOR_VERSION
+    return (f"{scale}:{n_rows}:{seed}:{'full' if full else 'glmix'}"
+            f":v={GENERATOR_VERSION}")
 
 
 def _ref_cache_get_raw(key: str):

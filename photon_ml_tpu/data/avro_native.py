@@ -116,6 +116,7 @@ def compile_schema(schema_json) -> Optional[DecodePlan]:
     tokens: List[int] = []
     columns: List[Tuple[str, int]] = []
     names: Dict[str, dict] = {}
+    in_progress: set = set()
 
     def new_col(path: str, kind: int) -> int:
         columns.append((path, kind))
@@ -123,6 +124,8 @@ def compile_schema(schema_json) -> Optional[DecodePlan]:
 
     def emit(node, path: str) -> bool:
         if isinstance(node, str):
+            if node in in_progress:
+                return False  # self-referential record: no flat program exists
             if node in names:
                 return emit(names[node], path)
             if node == "null":
@@ -149,12 +152,15 @@ def compile_schema(schema_json) -> Optional[DecodePlan]:
         if t == "record":
             full = node.get("namespace", "") + "." + node["name"] \
                 if node.get("namespace") else node["name"]
-            names[full] = names[node["name"]] = {
-                "type": "record", "fields": node["fields"]}
-            for f in node["fields"]:
-                fpath = f"{path}.{f['name']}" if path else f["name"]
-                if not emit(f["type"], fpath):
-                    return False
+            names[full] = names[node["name"]] = node
+            in_progress.update((full, node["name"]))
+            try:
+                for f in node["fields"]:
+                    fpath = f"{path}.{f['name']}" if path else f["name"]
+                    if not emit(f["type"], fpath):
+                        return False
+            finally:
+                in_progress.difference_update((full, node["name"]))
             return True
         if t == "array":
             count = new_col(path + "#count", KIND_I64)

@@ -31,6 +31,11 @@ from typing import Dict, Optional
 
 import numpy as np
 
+# Bump whenever any generator in this module changes its output for a given
+# seed.  bench.py folds this into its reference-optimum cache keys so a
+# generator change can never silently reuse stale float64 reference NLLs.
+GENERATOR_VERSION = "g2"
+
 
 def make_a1a_features(replicas: int = 1, seed: int = 42,
                       density: float = 0.115) -> np.ndarray:

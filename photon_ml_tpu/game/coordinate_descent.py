@@ -155,12 +155,21 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
         json.dump(state, f, indent=1)
     os.replace(tmp, os.path.join(directory, "state.json"))
     # prune the dirs the superseded record referenced (only the latest
-    # record is ever resumed from)
+    # record is ever resumed from); a foreign/corrupt state.json may point
+    # anywhere, so only delete paths contained in the checkpoint directory
     if prev is not None:
+        root = os.path.realpath(directory)
         for key in ("model_dir", "best_model_dir"):
             old = prev.get(key)
-            if old and old not in (path, best_path) and os.path.isdir(old):
-                shutil.rmtree(old, ignore_errors=True)
+            if not old or old in (path, best_path) or not os.path.isdir(old):
+                continue
+            real = os.path.realpath(old)
+            if os.path.commonpath([root, real]) != root or real == root:
+                logger.warning(
+                    "checkpoint state referenced %s outside the checkpoint "
+                    "directory %s; refusing to prune it", old, directory)
+                continue
+            shutil.rmtree(real, ignore_errors=True)
     logger.info("checkpoint: iteration %d saved to %s", iteration, path)
 
 
@@ -179,6 +188,7 @@ def read_checkpoint(directory: str,
     under different settings."""
     import json
     import os
+    import zipfile
 
     from photon_ml_tpu.models.io import load_game_model
 
@@ -207,7 +217,7 @@ def read_checkpoint(directory: str,
                                 state.get("validation_history", {}).items()},
             best_models=best,
             best_metric=state.get("best_metric"))
-    except (OSError, ValueError, KeyError) as e:
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
         if os.path.exists(state_path):
             logger.warning("checkpoint at %s unreadable (%s); starting fresh",
                            directory, e)

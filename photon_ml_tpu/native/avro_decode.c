@@ -113,11 +113,17 @@ static void skip_map(Cur *c) {
     while (!c->err) {
         int64_t n = read_varlong(c);
         if (n == 0) break;
-        if (n < 0) { read_varlong(c); n = -n; } /* block byte size follows */
+        if (n < 0) { /* block byte size follows */
+            if (n == INT64_MIN) { c->err = 1; return; } /* -n would be UB */
+            read_varlong(c);
+            n = -n;
+        }
         for (int64_t i = 0; i < n && !c->err; i++) {
             for (int k = 0; k < 2 && !c->err; k++) { /* key + string value */
                 int64_t len = read_varlong(c);
-                if (len < 0 || c->p + len > c->end) { c->err = 1; return; }
+                /* compare against remaining bytes — `c->p + len` would be
+                 * pointer-arithmetic overflow UB for adversarial lengths */
+                if (len < 0 || len > (int64_t)(c->end - c->p)) { c->err = 1; return; }
                 c->p += len;
             }
         }
@@ -153,7 +159,7 @@ static void exec_prog(Cur *c, const int32_t *prog, int64_t n, Col *cols,
             Col *col = &cols[prog[i++]];
             double v = 0.0 / 0.0; /* NaN placeholder */
             if (!null_mode) {
-                if (c->p + 8 > c->end) { c->err = 1; break; }
+                if ((int64_t)(c->end - c->p) < 8) { c->err = 1; break; }
                 memcpy(&v, c->p, 8);
                 c->p += 8;
             }
@@ -165,7 +171,7 @@ static void exec_prog(Cur *c, const int32_t *prog, int64_t n, Col *cols,
             double v = 0.0 / 0.0;
             if (!null_mode) {
                 float fv;
-                if (c->p + 4 > c->end) { c->err = 1; break; }
+                if ((int64_t)(c->end - c->p) < 4) { c->err = 1; break; }
                 memcpy(&fv, c->p, 4);
                 c->p += 4;
                 v = fv;
@@ -179,7 +185,7 @@ static void exec_prog(Cur *c, const int32_t *prog, int64_t n, Col *cols,
                 push_str(col, NULL, 0, &c->err);
             } else {
                 int64_t len = read_varlong(c);
-                if (len < 0 || c->p + len > c->end) { c->err = 1; break; }
+                if (len < 0 || len > (int64_t)(c->end - c->p)) { c->err = 1; break; }
                 push_str(col, c->p, len, &c->err);
                 c->p += len;
             }
@@ -209,7 +215,11 @@ static void exec_prog(Cur *c, const int32_t *prog, int64_t n, Col *cols,
                 while (!c->err) {
                     int64_t bn = read_varlong(c);
                     if (bn == 0) break;
-                    if (bn < 0) { read_varlong(c); bn = -bn; }
+                    if (bn < 0) {
+                        if (bn == INT64_MIN) { c->err = 1; break; }
+                        read_varlong(c);
+                        bn = -bn;
+                    }
                     for (int64_t j = 0; j < bn && !c->err; j++)
                         exec_prog(c, prog + i, body_len, cols, 0);
                     total += bn;

@@ -134,3 +134,28 @@ def test_decode_throughput(tmp_path):
     print(f"native: {rate:.0f} MB/s, python: {nbytes / python_s / 1e6:.1f} "
           f"MB/s, speedup {python_s / native_s:.0f}x")
     assert native_s * 3 < python_s, (native_s, python_s)
+
+
+def test_recursive_schema_rejected():
+    """A self-referential record has no flat op program; compile_schema must
+    return None (fallback) instead of recursing unboundedly (ADVICE r3)."""
+    from photon_ml_tpu.data.avro_native import compile_schema
+    schema = {"type": "record", "name": "Node", "fields": [
+        {"name": "value", "type": "long"},
+        {"name": "next", "type": ["null", "Node"]},
+    ]}
+    assert compile_schema(schema) is None
+
+
+def test_named_record_reuse_compiles():
+    """Non-recursive reuse of a named record type must compile (each use
+    site gets its own columns), not crash."""
+    from photon_ml_tpu.data.avro_native import compile_schema
+    schema = {"type": "record", "name": "Outer", "fields": [
+        {"name": "a", "type": {"type": "record", "name": "Inner", "fields": [
+            {"name": "v", "type": "long"}]}},
+        {"name": "b", "type": "Inner"},
+    ]}
+    plan = compile_schema(schema)
+    assert plan is not None
+    assert [c for c, _ in plan.columns] == ["a.v", "b.v"]

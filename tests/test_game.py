@@ -366,3 +366,43 @@ def test_checkpoint_edge_cases(rng, tmp_path):
     res3 = GameEstimator(_config(task="logistic_regression", iters=1)).fit(
         train, val, checkpoint_dir=ckpt)
     assert res3.descent.total_iterations() > 0
+
+
+def test_checkpoint_prune_refuses_foreign_paths(rng, tmp_path):
+    """A corrupt/foreign state.json pointing outside the checkpoint dir must
+    never be rmtree'd (ADVICE r3 medium): the prune step only deletes paths
+    contained in the checkpoint directory."""
+    import json
+
+    ds, _ = _dataset(rng, task="logistic")
+    ckpt = tmp_path / "ckpt"
+    victim = tmp_path / "victim"
+    victim.mkdir()
+    (victim / "precious.txt").write_text("do not delete")
+    ckpt.mkdir()
+    # forge a state record whose model_dir points OUTSIDE the checkpoint dir
+    with open(ckpt / "state.json", "w") as f:
+        json.dump({"completed_iterations": 0, "model_dir": str(victim),
+                   "best_model_dir": None, "best_metric": None,
+                   "objective_history": [], "validation_history": {}}, f)
+    GameEstimator(_config(task="logistic_regression", iters=1)).fit(
+        ds, checkpoint_dir=str(ckpt))
+    assert (victim / "precious.txt").exists()
+
+
+def test_checkpoint_corrupt_npz_falls_back_fresh(rng, tmp_path):
+    """A truncated coefficients archive in the checkpointed model raises
+    BadZipFile on load; read_checkpoint must treat it as no-checkpoint
+    (fresh retrain), not crash (ADVICE r3 low)."""
+    import glob
+
+    ds, _ = _dataset(rng, task="logistic")
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator(_config(task="logistic_regression", iters=1)).fit(
+        ds, checkpoint_dir=ckpt)
+    for npz in glob.glob(f"{ckpt}/iter-*/**/*.npz", recursive=True):
+        with open(npz, "wb") as f:
+            f.write(b"PK\x03\x04 truncated")
+    res = GameEstimator(_config(task="logistic_regression", iters=1)).fit(
+        ds, checkpoint_dir=ckpt)
+    assert res.descent.total_iterations() > 0  # retrained, no crash
