@@ -31,8 +31,19 @@ def build_parser() -> argparse.ArgumentParser:
         prog="photon-ml-tpu-train",
         description="Train GLM / GAME mixed-effect models on TPU (JAX)")
     p.add_argument("--train-data", required=True,
-                   help=".npz GameDataset or .libsvm file")
+                   help=".npz GameDataset, .libsvm file, or Avro input "
+                        "(.avro file, directory of .avro files, or glob)")
     p.add_argument("--validation-data", default=None)
+    p.add_argument("--feature-shard-map", default=None,
+                   help="Avro inputs: JSON (inline or @file) mapping shard "
+                        "name -> list of feature-bag fields to merge, e.g. "
+                        "'{\"global\": [\"features\"], \"per_user\": "
+                        "[\"userFeatures\"]}' (reference: readMerged "
+                        "featureColumnMap); default merges the 'features' "
+                        "bag into one 'global' shard")
+    p.add_argument("--id-columns", default=None,
+                   help="Avro inputs: comma-separated random-effect id tags "
+                        "to extract (top-level field or metadataMap key)")
     p.add_argument("--task", default="logistic_regression",
                    choices=["logistic_regression", "linear_regression",
                             "poisson_regression", "smoothed_hinge_loss_linear_svm"])
@@ -104,12 +115,69 @@ def make_mesh_from_arg(mesh_arg: str):
     return make_mesh(int(d), int(f) if f else 1)
 
 
-def _load_dataset(path: str, task: str):
+def resolve_avro_paths(path: str):
+    """'.avro' file, directory of .avro files, or glob -> sorted paths, or
+    None when `path` is not an Avro input.  A directory or glob that yields
+    NO .avro files is an explicit error, not a silent fall-through."""
+    import glob as _glob
+    if os.path.isdir(path):
+        found = sorted(_glob.glob(os.path.join(path, "*.avro")))
+        if not found:
+            raise SystemExit(f"no .avro files found in directory {path!r}")
+        return found
+    if "*" in path or "?" in path:
+        found = sorted(p for p in _glob.glob(path) if p.endswith(".avro"))
+        if not found:
+            raise SystemExit(f"glob {path!r} matched no .avro files")
+        return found
+    if path.endswith(".avro"):
+        return [path]
+    return None
+
+
+def parse_feature_shard_map(arg):
+    """JSON inline or @file -> {shard: [bags]}; default single-shard merge
+    of the TrainingExampleAvro 'features' bag."""
+    if arg is None:
+        return {"global": ["features"]}
+    text = arg
+    if arg.startswith("@"):
+        with open(arg[1:]) as f:
+            text = f.read()
+    m = json.loads(text)
+    if not isinstance(m, dict) or not all(
+            isinstance(v, list) and v for v in m.values()):
+        raise SystemExit("--feature-shard-map must be a JSON object mapping "
+                         "shard name -> non-empty list of bag fields")
+    return m
+
+
+def _load_dataset(path: str, task: str, args=None, train_dataset=None):
+    """`train_dataset` pins a validation read to the TRAINING feature/entity
+    spaces: separately-scanned Avro validation data would otherwise build
+    its own sorted vocabularies and silently misalign columns with the
+    trained coefficients."""
     from photon_ml_tpu.data import build_game_dataset, read_libsvm
     from photon_ml_tpu.data.game_data import load_game_dataset
     if path.endswith(".libsvm") or path.endswith(".txt"):
         x, y = read_libsvm(path)
         return build_game_dataset(y, {"global": x})
+    avro_paths = resolve_avro_paths(path)
+    if avro_paths is not None:
+        # reference: AvroDataReader.readMerged + GameConverters — the
+        # primary input path of the GAME training driver
+        from photon_ml_tpu.data.avro_game import read_game_examples
+        shard_map = parse_feature_shard_map(
+            getattr(args, "feature_shard_map", None) if args else None)
+        id_cols = (getattr(args, "id_columns", None) or "") if args else ""
+        result = read_game_examples(
+            avro_paths, shard_map,
+            id_columns=[c for c in id_cols.split(",") if c],
+            index_maps=(train_dataset.index_maps or None
+                        if train_dataset is not None else None),
+            entity_vocabs=(train_dataset.entity_vocabs or None
+                           if train_dataset is not None else None))
+        return result.dataset
     return load_game_dataset(path)
 
 
@@ -166,9 +234,11 @@ def _run(args, log) -> int:
                                      RegularizationContext, RegularizationType)
 
     t0 = time.time()
-    train = _load_dataset(args.train_data, args.task)
-    val = (_load_dataset(args.validation_data, args.task)
+    train = _load_dataset(args.train_data, args.task, args)
+    val = (_load_dataset(args.validation_data, args.task, args,
+                         train_dataset=train)
            if args.validation_data else None)
+    ingest_s = time.time() - t0
     log.info("loaded train: %d rows, shards %s", train.num_rows,
              {s: x.shape[1] for s, x in train.feature_shards.items()})
     print(f"loaded train: {train.num_rows} rows, shards "
@@ -273,6 +343,7 @@ def _run(args, log) -> int:
         summary = {
             "task": args.task,
             "train_rows": train.num_rows,
+            "ingest_s": round(ingest_s, 2),
             "num_configs": len(results),
             "final_objective": best.objective_history[-1],
             "validation": best.validation,

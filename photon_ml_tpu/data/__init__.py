@@ -1,3 +1,6 @@
+from photon_ml_tpu.data.avro_game import (  # noqa: F401
+    GameAvroResult, read_game_examples, write_game_examples,
+)
 from photon_ml_tpu.data.batching import (  # noqa: F401
     FixedEffectDataConfig, FixedEffectDataset, RandomEffectDataConfig,
     RandomEffectDataset, build_random_effect_dataset,

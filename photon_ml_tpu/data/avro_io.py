@@ -24,7 +24,7 @@ import numpy as np
 
 from photon_ml_tpu.data.avro_codec import read_container, write_container
 from photon_ml_tpu.data.index_map import (
-    INTERCEPT_KEY, IndexMap, build_index_map, feature_key,
+    DELIMITER, INTERCEPT_KEY, IndexMap, build_index_map, feature_key,
 )
 
 _NS = "com.linkedin.photon.avro.generated"
@@ -136,16 +136,32 @@ def _read_training_examples_native(paths, index_map):
     n = len(y)
     counts = np.concatenate([c["features#count"] for c in cols_list])
     values = np.concatenate([c["features.value"] for c in cols_list])
-    names: List[str] = []
-    terms: List[str] = []
-    for c in cols_list:
-        names.extend(c["features.name"].to_list())
-        terms.extend(c["features.term"].to_list())
+    # vectorized (name, term) -> index: fixed-width byte keys + np.unique;
+    # Python touches only the VOCABULARY, never the occurrence stream
+    from photon_ml_tpu.data.avro_native import concat_str_columns
+    delim = DELIMITER.encode()
+    names_b = concat_str_columns([c["features.name"] for c in cols_list]
+                                 ).to_bytes_array()
+    terms_b = concat_str_columns([c["features.term"] for c in cols_list]
+                                 ).to_bytes_array()
+    keys = np.char.add(np.char.add(names_b, delim), terms_b)
+    uniq, codes = np.unique(keys, return_inverse=True)
     if index_map is None:
-        index_map = build_index_map(list(zip(names, terms)),
-                                    add_intercept=True)
-    col_idx = np.asarray([index_map.index_of(nm, tm)
-                          for nm, tm in zip(names, terms)], dtype=np.int64)
+        decoded = [k.decode("utf-8") for k in uniq.tolist()]
+        index_map = IndexMap.from_keys(decoded, add_intercept=True)
+        if INTERCEPT_KEY in decoded:
+            # an explicit intercept key moves to the LAST slot in from_keys,
+            # breaking the sorted-position identity — use the lookup instead
+            lut = np.asarray([index_map.key_to_index[k] for k in decoded],
+                             dtype=np.int64)
+        else:
+            # np.unique's bytewise sort order == sorted() UTF-8 order, so
+            # the vocabulary positions equal IndexMap.from_keys positions
+            lut = np.arange(len(uniq), dtype=np.int64)
+    else:
+        lut = np.asarray([index_map.key_to_index.get(k.decode("utf-8"), -1)
+                          for k in uniq.tolist()], dtype=np.int64)
+    col_idx = lut[codes] if len(codes) else np.zeros(0, np.int64)
     row_idx = np.repeat(np.arange(n), counts)
 
     x = np.zeros((n, index_map.size))

@@ -34,7 +34,7 @@ _SRC = os.path.join(_NATIVE_DIR, "avro_decode.c")
 _SO = os.path.join(_NATIVE_DIR, "libavrodec.so")
 
 OP_LONG, OP_DOUBLE, OP_FLOAT, OP_BOOL, OP_STRING, OP_ENUM, OP_OPT, \
-    OP_ARRAY, OP_MAP_SKIP = range(9)
+    OP_ARRAY, OP_MAP_SKIP, OP_MAP = range(10)
 KIND_I64, KIND_F64, KIND_STR = range(3)
 
 _PRIMITIVE_OPS = {"long": (OP_LONG, KIND_I64), "int": (OP_LONG, KIND_I64),
@@ -104,6 +104,71 @@ class StrColumn:
     def to_numpy(self) -> np.ndarray:
         return np.asarray(self.to_list(), dtype=object)
 
+    def to_bytes_array(self) -> np.ndarray:
+        """Fixed-width `S(W)` numpy array, built with a vectorized ragged
+        gather — no per-element Python.  This is what lets corpus-scale
+        (name, term) -> index mapping run at numpy speed (np.unique /
+        searchsorted over the S array) instead of a Python loop per feature
+        occurrence."""
+        n = len(self.offsets)
+        if n == 0:
+            return np.zeros(0, dtype="S1")
+        offs = self.offsets
+        lens = np.diff(offs, prepend=0)
+        w = max(int(lens.max()), 1)
+        buf = np.zeros((n, w), dtype=np.uint8)
+        total = int(offs[-1])
+        if total:
+            starts = offs - lens
+            byte_row = np.repeat(np.arange(n), lens)
+            byte_pos = np.arange(total) - np.repeat(starts, lens)
+            buf[byte_row, byte_pos] = np.frombuffer(self.blob, np.uint8,
+                                                    count=total)
+        return buf.view(f"S{w}").ravel()
+
+    def to_str_array(self) -> np.ndarray:
+        """Unicode array decoded from the fixed-width bytes (vectorized)."""
+        return np.char.decode(self.to_bytes_array(), "utf-8")
+
+    def lengths(self) -> np.ndarray:
+        return np.diff(self.offsets, prepend=0)
+
+    def take_bytes(self, idx: np.ndarray) -> np.ndarray:
+        """Fixed-width `S(W)` array of the SELECTED elements only — the
+        padded width is the max over `idx`, not the whole column, so one
+        long outlier elsewhere cannot inflate the gather."""
+        idx = np.asarray(idx)
+        if len(idx) == 0:
+            return np.zeros(0, dtype="S1")
+        lens_all = self.lengths()
+        starts_all = self.offsets - lens_all
+        lens = lens_all[idx]
+        starts = starts_all[idx]
+        w = max(int(lens.max()), 1)
+        total = int(lens.sum())
+        buf = np.zeros((len(idx), w), dtype=np.uint8)
+        if total:
+            within = np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens)
+            src = np.repeat(starts, lens) + within
+            blob = np.frombuffer(self.blob, np.uint8)
+            buf[np.repeat(np.arange(len(idx)), lens), within] = blob[src]
+        return buf.view(f"S{w}").ravel()
+
+
+def concat_str_columns(cols: List[StrColumn]) -> StrColumn:
+    """Concatenate string columns (offsets of later columns are shifted by
+    the cumulative blob length)."""
+    if len(cols) == 1:
+        return cols[0]
+    parts, shift = [], 0
+    blobs = []
+    for c in cols:
+        parts.append(c.offsets + shift)
+        blobs.append(c.blob)
+        shift += len(c.blob)
+    return StrColumn(np.concatenate(parts) if parts else
+                     np.zeros(0, np.int64), b"".join(blobs))
+
 
 @dataclasses.dataclass
 class DecodePlan:
@@ -111,8 +176,11 @@ class DecodePlan:
     columns: List[Tuple[str, int]]  # (path, KIND_*)
 
 
-def compile_schema(schema_json) -> Optional[DecodePlan]:
-    """Record schema -> op program, or None when a shape is unsupported."""
+def compile_schema(schema_json, decode_maps: bool = False
+                   ) -> Optional[DecodePlan]:
+    """Record schema -> op program, or None when a shape is unsupported.
+    `decode_maps` materializes map<string,string> fields as key/value/count
+    columns (GAME id-tag extraction); off by default — skipping is cheaper."""
     tokens: List[int] = []
     columns: List[Tuple[str, int]] = []
     names: Dict[str, dict] = {}
@@ -175,7 +243,17 @@ def compile_schema(schema_json) -> Optional[DecodePlan]:
             values = node["values"]
             if values not in ("string", "bytes"):
                 return False
-            tokens.append(OP_MAP_SKIP)
+            if not decode_maps:
+                tokens.append(OP_MAP_SKIP)
+                return True
+            # decoded for GAME ingest: id tags may live in metadataMap
+            # (reference: GameConverters.getIdTagToValueMapFromRow falls back
+            # to the metadata map when no top-level id column exists); other
+            # readers skip maps to keep the hot path free of metadata copies
+            count = new_col(path + "#count", KIND_I64)
+            kcol = new_col(path + ".key", KIND_STR)
+            vcol = new_col(path + ".value", KIND_STR)
+            tokens.extend([OP_MAP, count, kcol, vcol])
             return True
         if t == "enum":
             tokens.extend([OP_ENUM, new_col(path, KIND_I64)])
@@ -189,14 +267,14 @@ def compile_schema(schema_json) -> Optional[DecodePlan]:
     return DecodePlan(np.asarray(tokens, dtype=np.int32), columns)
 
 
-def read_columnar(path: str):
+def read_columnar(path: str, decode_maps: bool = False):
     """Decode a container file into columns, or None when the native path
     is unavailable / the schema is unsupported (callers fall back)."""
     lib = _load_lib()
     if lib is None:
         return None
     schema_json, blocks = iter_raw_blocks(path)
-    plan = compile_schema(schema_json)
+    plan = compile_schema(schema_json, decode_maps=decode_maps)
     if plan is None:
         return None
 

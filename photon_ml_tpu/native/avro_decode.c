@@ -31,7 +31,8 @@ enum {
     OP_ENUM = 5,    /* col */
     OP_OPT = 6,     /* null_branch_index, present_col, body_len, body... */
     OP_ARRAY = 7,   /* count_col, body_len, body... */
-    OP_MAP_SKIP = 8 /* (no args) skip map<string, string-or-bytes-like> */
+    OP_MAP_SKIP = 8,/* (no args) skip map<string, string-or-bytes-like> */
+    OP_MAP = 9      /* count_col, key_col, val_col: map<string, string> */
 };
 
 enum { KIND_I64 = 0, KIND_F64 = 1, KIND_STR = 2 };
@@ -233,6 +234,37 @@ static void exec_prog(Cur *c, const int32_t *prog, int64_t n, Col *cols,
         case OP_MAP_SKIP:
             if (!null_mode) skip_map(c);
             break;
+        case OP_MAP: {
+            int32_t count_col = prog[i++];
+            int32_t key_col = prog[i++];
+            int32_t val_col = prog[i++];
+            int64_t total = 0;
+            if (!null_mode) {
+                while (!c->err) {
+                    int64_t bn = read_varlong(c);
+                    if (bn == 0) break;
+                    if (bn < 0) {
+                        if (bn == INT64_MIN) { c->err = 1; break; }
+                        read_varlong(c);
+                        bn = -bn;
+                    }
+                    for (int64_t j = 0; j < bn && !c->err; j++) {
+                        int64_t len = read_varlong(c);
+                        if (len < 0 || len > (int64_t)(c->end - c->p)) { c->err = 1; break; }
+                        push_str(&cols[key_col], c->p, len, &c->err);
+                        c->p += len;
+                        len = read_varlong(c);
+                        if (len < 0 || len > (int64_t)(c->end - c->p)) { c->err = 1; break; }
+                        push_str(&cols[val_col], c->p, len, &c->err);
+                        c->p += len;
+                    }
+                    total += bn;
+                }
+            }
+            if (count_col >= 0)
+                push_i64(&cols[count_col], total, &c->err);
+            break;
+        }
         default:
             c->err = 1;
         }
