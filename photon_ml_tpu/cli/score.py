@@ -1,11 +1,12 @@
 """Scoring CLI.
 
 reference: GAME scoring driver (photon-client/.../cli/game/scoring/
-Driver.scala:37-309): load model + data -> score -> save scores + optional
-evaluation.
+Driver.scala:37-309): load model + data -> score -> save scores
+(ScoringResultAvro) + optional evaluation.
 
   python -m photon_ml_tpu.cli.score --model-dir out/best \
-      --data test.npz --output scores.npz [--evaluators AUC,RMSE]
+      --data test.npz|test.avro --output scores[.npz|.avro]
+      [--format npz|avro] [--evaluators AUC,RMSE]
 """
 from __future__ import annotations
 
@@ -19,36 +20,121 @@ import numpy as np
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="photon-ml-tpu-score")
     p.add_argument("--model-dir", required=True)
-    p.add_argument("--data", required=True, help=".npz GameDataset or .libsvm")
-    p.add_argument("--output", required=True, help="scores .npz output path")
+    p.add_argument("--data", required=True,
+                   help=".npz GameDataset, .libsvm, or Avro input (file, "
+                        "directory, or glob)")
+    p.add_argument("--output", required=True, help="scores output path")
+    p.add_argument("--format", default="npz", choices=["npz", "avro"],
+                   help="score output format; avro writes ScoringResultAvro "
+                        "records (reference: ScoreProcessingUtils)")
+    p.add_argument("--model-id", default=None,
+                   help="modelId stamped into ScoringResultAvro records "
+                        "(default: the model directory name)")
+    p.add_argument("--feature-shard-map", default=None,
+                   help="Avro inputs: JSON (inline or @file) shard -> bags "
+                        "merge map (see cli.train)")
+    p.add_argument("--id-columns", default=None,
+                   help="Avro inputs: comma-separated id tags to extract")
     p.add_argument("--evaluators", default=None)
     p.add_argument("--predict", action="store_true",
-                   help="also emit mean predictions (inverse link)")
+                   help="also emit mean predictions (inverse link; npz only)")
     p.add_argument("--mesh", default="auto",
                    help="'auto' = all local devices, 'none', or 'DxF'")
+    p.add_argument("--no-compile-cache", action="store_true",
+                   help="disable the persistent XLA compilation cache")
     return p
+
+
+def _load_scoring_data(args, model, model_dir):
+    """Avro scoring input reads in the MODEL's feature/entity spaces
+    (reference: the scoring driver resolves features through the trained
+    model's index maps; unseen entities score through the fixed effect
+    only).  Returns (dataset, uids or None)."""
+    from photon_ml_tpu.cli.train import (_load_dataset, parse_feature_shard_map,
+                                         resolve_avro_paths)
+    avro_paths = resolve_avro_paths(args.data)
+    if avro_paths is None:
+        return _load_dataset(args.data, model.task_type), None
+    from photon_ml_tpu.data.avro_game import read_game_examples
+    from photon_ml_tpu.models.game import MatrixFactorizationModel
+    from photon_ml_tpu.models.io import load_model_index_maps
+    id_cols = [c for c in (args.id_columns or "").split(",") if c]
+    entity_vocabs = {}
+
+    def add_tag(tag, vocab):
+        if tag is None:
+            return
+        entity_vocabs.setdefault(tag, np.asarray(vocab))
+        if tag not in id_cols:
+            id_cols.append(tag)
+
+    for m in model.coordinates.values():
+        if isinstance(m, MatrixFactorizationModel):
+            add_tag(m.row_effect_type, m.row_ids)
+            add_tag(m.col_effect_type, m.col_ids)
+        elif getattr(m, "random_effect_type", None) is not None \
+                and hasattr(m, "entity_ids"):
+            add_tag(m.random_effect_type, m.entity_ids)
+    index_maps = load_model_index_maps(model_dir)
+    if index_maps is None:
+        raise SystemExit(
+            f"model at {model_dir!r} records no index-maps directory, so "
+            "Avro scoring data cannot be resolved into the model's feature "
+            "space (columns would silently misalign). Re-save the model "
+            "with index maps, or score from an npz GameDataset instead.")
+    result = read_game_examples(
+        avro_paths, parse_feature_shard_map(args.feature_shard_map),
+        id_columns=id_cols,
+        index_maps=index_maps,
+        entity_vocabs=entity_vocabs or None,
+        require_response=False)
+    return result.dataset, result.uids
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    from photon_ml_tpu.cli.train import _load_dataset, make_mesh_from_arg
+    from photon_ml_tpu.cli.train import make_mesh_from_arg
     from photon_ml_tpu.evaluation import parse_evaluator
     from photon_ml_tpu.models.io import load_game_model
+    from photon_ml_tpu.utils.jax_cache import (CompileTimeTracker,
+                                               enable_persistent_cache)
+
+    compile_tracker = CompileTimeTracker().install()
+    if not args.no_compile_cache:
+        enable_persistent_cache()
 
     model, _config = load_game_model(args.model_dir)
-    ds = _load_dataset(args.data, model.task_type)
+    ds, uids = _load_scoring_data(args, model, args.model_dir)
     mesh = make_mesh_from_arg(args.mesh)
     scores = np.asarray(model.score_dataset(ds, mesh))
-    out = {"scores": scores}
-    if args.predict:
-        out["predictions"] = np.asarray(model.predict(ds, mesh))
-    np.savez_compressed(args.output if args.output.endswith(".npz")
-                        else args.output + ".npz", **out)
+
+    has_response = not np.isnan(np.asarray(ds.response)).all()
+    if args.format == "avro":
+        from photon_ml_tpu.data.avro_io import write_scores_avro
+        import os
+        out_path = (args.output if args.output.endswith(".avro")
+                    else args.output + ".avro")
+        model_id = args.model_id or os.path.basename(
+            args.model_dir.rstrip("/")) or "model"
+        write_scores_avro(out_path, model_id, scores,
+                          labels=ds.response if has_response else None,
+                          weights=ds.weights, uids=uids)
+    else:
+        out = {"scores": scores}
+        if args.predict:
+            out["predictions"] = np.asarray(model.predict(ds, mesh))
+        np.savez_compressed(args.output if args.output.endswith(".npz")
+                            else args.output + ".npz", **out)
 
     result = {"rows": int(ds.num_rows), "output": args.output,
+              "format": args.format,
+              "compile_s": round(compile_tracker.seconds, 2),
               "evaluation": {}}
     if args.evaluators:
+        if not has_response:
+            raise SystemExit("--evaluators requires labeled scoring data "
+                             "(the input has no response column)")
         total = scores + (ds.offsets if ds.offsets is not None else 0.0)
         for spec in args.evaluators.split(","):
             ev, group = parse_evaluator(spec)

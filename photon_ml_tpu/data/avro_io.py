@@ -136,32 +136,12 @@ def _read_training_examples_native(paths, index_map):
     n = len(y)
     counts = np.concatenate([c["features#count"] for c in cols_list])
     values = np.concatenate([c["features.value"] for c in cols_list])
-    # vectorized (name, term) -> index: fixed-width byte keys + np.unique;
-    # Python touches only the VOCABULARY, never the occurrence stream
-    from photon_ml_tpu.data.avro_native import concat_str_columns
-    delim = DELIMITER.encode()
-    names_b = concat_str_columns([c["features.name"] for c in cols_list]
-                                 ).to_bytes_array()
-    terms_b = concat_str_columns([c["features.term"] for c in cols_list]
-                                 ).to_bytes_array()
-    keys = np.char.add(np.char.add(names_b, delim), terms_b)
-    uniq, codes = np.unique(keys, return_inverse=True)
-    if index_map is None:
-        decoded = [k.decode("utf-8") for k in uniq.tolist()]
-        index_map = IndexMap.from_keys(decoded, add_intercept=True)
-        if INTERCEPT_KEY in decoded:
-            # an explicit intercept key moves to the LAST slot in from_keys,
-            # breaking the sorted-position identity — use the lookup instead
-            lut = np.asarray([index_map.key_to_index[k] for k in decoded],
-                             dtype=np.int64)
-        else:
-            # np.unique's bytewise sort order == sorted() UTF-8 order, so
-            # the vocabulary positions equal IndexMap.from_keys positions
-            lut = np.arange(len(uniq), dtype=np.int64)
-    else:
-        lut = np.asarray([index_map.key_to_index.get(k.decode("utf-8"), -1)
-                          for k in uniq.tolist()], dtype=np.int64)
-    col_idx = lut[codes] if len(codes) else np.zeros(0, np.int64)
+    # vectorized (name, term) -> index resolution; Python touches only the
+    # VOCABULARY, never the occurrence stream (avro_native.py helper)
+    from photon_ml_tpu.data.avro_native import resolve_feature_keys
+    index_map, col_idx = resolve_feature_keys(
+        [c["features.name"] for c in cols_list],
+        [c["features.term"] for c in cols_list], index_map)
     row_idx = np.repeat(np.arange(n), counts)
 
     x = np.zeros((n, index_map.size))
@@ -298,6 +278,75 @@ def read_glm_avro(path: str, index_map: Optional[IndexMap] = None
                 variances[j] = f["value"]
     task = _TASK_BY_CLASS.get(rec.get("modelClass") or "", None)
     return rec["modelId"], task, means, variances, index_map
+
+
+def write_random_effect_avro(path: str, task_type: str,
+                             entity_ids, coefficients: np.ndarray,
+                             index_map: IndexMap,
+                             projection: Optional[np.ndarray] = None,
+                             variances: Optional[np.ndarray] = None) -> None:
+    """Per-entity GLMs -> one container of BayesianLinearModelAvro records
+    (modelId = entity id), always in ORIGINAL feature space — the reference
+    stores random-effect models per entity under random-effect/<coord>/
+    (ModelProcessingUtils.scala:71-135) with name.term feature keys.
+
+    `coefficients` is [E, d_local]; `projection` (optional, [E, d_local])
+    maps local slots to global columns (-1 = padding), exactly the
+    RandomEffectModel layout, so projected models export without
+    materializing [E, d_global]."""
+    coefficients = np.asarray(coefficients)
+    variances = None if variances is None else np.asarray(variances)
+
+    def ntv_entity(vec, e):
+        out = []
+        for j in np.nonzero(vec)[0]:
+            g = int(j) if projection is None else int(projection[e, j])
+            if g < 0:
+                continue
+            name, term = index_map.name_term(g)
+            out.append({"name": name, "term": term, "value": float(vec[j])})
+        return out
+
+    def gen():
+        for e, eid in enumerate(np.asarray(entity_ids)):
+            yield {"modelId": str(eid),
+                   "modelClass": _MODEL_CLASS.get(task_type),
+                   "means": ntv_entity(coefficients[e], e),
+                   "variances": (None if variances is None
+                                 else ntv_entity(variances[e], e)),
+                   "lossFunction": None}
+
+    write_container(path, BAYESIAN_LINEAR_MODEL_AVRO, gen())
+
+
+def read_random_effect_avro(path: str, index_map: Optional[IndexMap] = None
+                            ) -> Tuple[List[str], np.ndarray,
+                                       Optional[np.ndarray], IndexMap]:
+    """-> (entity_ids, means [E, d], variances or None, index_map); models
+    come back dense in ORIGINAL space (projection is a training-time
+    artifact, reference loads are original-space too)."""
+    recs = list(read_container(path))
+    if index_map is None:
+        keys = []
+        for rec in recs:
+            keys.extend((f["name"], f["term"]) for f in rec["means"])
+        index_map = build_index_map(keys, add_intercept=True)
+    e_ids = [rec["modelId"] for rec in recs]
+    d = index_map.size
+    means = np.zeros((len(recs), d))
+    any_var = any(rec.get("variances") for rec in recs)
+    variances = np.zeros((len(recs), d)) if any_var else None
+    for e, rec in enumerate(recs):
+        for f in rec["means"]:
+            j = index_map.index_of(f["name"], f["term"])
+            if j >= 0:
+                means[e, j] = f["value"]
+        if any_var:
+            for f in rec.get("variances") or ():
+                j = index_map.index_of(f["name"], f["term"])
+                if j >= 0:
+                    variances[e, j] = f["value"]
+    return e_ids, means, variances, index_map
 
 
 # -- scores ------------------------------------------------------------------

@@ -155,6 +155,73 @@ class StrColumn:
         return buf.view(f"S{w}").ravel()
 
 
+def resolve_feature_keys(name_cols: List[StrColumn],
+                         term_cols: List[StrColumn],
+                         index_map=None, delim: bytes = b"\x01"):
+    """(name, term) occurrence stream -> (index_map, col_idx [nnz]).
+
+    The one shared implementation of vectorized feature-key resolution
+    (used by both the single-bag reader and the merged GAME reader):
+    occurrences are bucketed BY TOTAL KEY LENGTH before the fixed-width
+    encode, so memory is bounded by the actual key bytes — one long feature
+    name cannot inflate the whole stream's padding.  Python only ever
+    touches the per-shard VOCABULARY.
+
+    When `index_map` is None a new map is built (sorted keys + intercept,
+    IndexMap.from_keys layout); otherwise unseen keys resolve to -1."""
+    from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap
+
+    nlens = np.concatenate([c.lengths() for c in name_cols]) \
+        if name_cols else np.zeros(0, np.int64)
+    tlens = np.concatenate([c.lengths() for c in term_cols]) \
+        if term_cols else np.zeros(0, np.int64)
+    total = len(nlens)
+    if total == 0:
+        imap = index_map if index_map is not None else IndexMap.from_keys([])
+        return imap, np.zeros(0, np.int64)
+    key_lens = nlens + tlens + len(delim)
+
+    # per-length-bucket fixed-width encode + unique
+    names_all = concat_str_columns(name_cols)
+    terms_all = concat_str_columns(term_cols)
+    bucket_vocabs = []
+    bucket_codes = np.zeros(total, np.int64)
+    bucket_base: List[int] = []
+    order_idx = []
+    for L in np.unique(key_lens):
+        idx = np.flatnonzero(key_lens == L)
+        keys_l = np.char.add(np.char.add(names_all.take_bytes(idx), delim),
+                             terms_all.take_bytes(idx))
+        uniq_l, codes_l = np.unique(keys_l, return_inverse=True)
+        bucket_base.append(sum(len(v) for v in bucket_vocabs))
+        bucket_vocabs.append(uniq_l)
+        bucket_codes[idx] = codes_l + bucket_base[-1]
+        order_idx.append(idx)
+
+    # merge bucket vocabularies into one globally sorted vocabulary
+    w = max(int(v.dtype.itemsize) for v in bucket_vocabs)
+    cat = np.concatenate([v.astype(f"S{w}") for v in bucket_vocabs])
+    uniq, inv = np.unique(cat, return_inverse=True)  # inv: bucket slot -> global
+    codes = inv[bucket_codes]
+
+    decoded = [k.decode("utf-8") for k in uniq.tolist()]
+    if index_map is None:
+        index_map = IndexMap.from_keys(decoded, add_intercept=True)
+        if INTERCEPT_KEY in decoded:
+            # from_keys moves an explicit intercept key to the LAST slot,
+            # breaking the sorted-position identity — fall back to lookup
+            lut = np.asarray([index_map.key_to_index[k] for k in decoded],
+                             dtype=np.int64)
+        else:
+            # np.unique sorts S-arrays bytewise; UTF-8 byte order ==
+            # code-point order, so positions match from_keys' sorted layout
+            lut = np.arange(len(uniq), dtype=np.int64)
+    else:
+        lut = np.asarray([index_map.key_to_index.get(k, -1)
+                          for k in decoded], dtype=np.int64)
+    return index_map, lut[codes]
+
+
 def concat_str_columns(cols: List[StrColumn]) -> StrColumn:
     """Concatenate string columns (offsets of later columns are shifted by
     the cumulative blob length)."""

@@ -26,7 +26,8 @@ from typing import Dict, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.index_map import (IndexMap, IndexMapCollection,
+                                          feature_key)
 from photon_ml_tpu.game.config import GameTrainingConfig
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.game import (
@@ -38,14 +39,40 @@ from photon_ml_tpu.models.glm import model_for_task
 _FORMAT_VERSION = 1
 
 
+def _shard_index_map(index_maps, shard, dim) -> IndexMap:
+    """The shard's map, or a synthesized zero-padded one (sorted order ==
+    column order) when none was recorded — Avro records key features by
+    name.term, so SOME map must exist."""
+    imap = (index_maps or {}).get(shard)
+    if imap is not None:
+        return imap
+    return IndexMap.from_keys(
+        [feature_key(f"{j:09d}") for j in range(dim - 1)], add_intercept=True)
+
+
 def save_game_model(
     model: GameModel,
     directory: str,
     config: Optional[GameTrainingConfig] = None,
     index_maps: Optional[Dict[str, IndexMap]] = None,
+    format: str = "npz",
 ) -> None:
-    """reference: ModelProcessingUtils.saveGameModelsToHDFS (scala:71-135)."""
+    """reference: ModelProcessingUtils.saveGameModelsToHDFS (scala:71-135).
+
+    `format="avro"` writes the reference's interchange records instead of
+    npz: BayesianLinearModelAvro per fixed-effect model and per random-effect
+    entity (original feature space, name.term keys), LatentFactorAvro for
+    matrix factorization — a model the Spark implementation can read.
+    Factored random effects materialize to per-entity original-space models
+    on Avro save (the reference persists original-space models too)."""
+    if format == "avro":
+        return _save_game_model_avro(model, directory, config, index_maps)
+    if format != "npz":
+        raise ValueError(f"unknown model format {format!r}")
     os.makedirs(directory, exist_ok=True)
+    if index_maps:
+        IndexMapCollection(dict(index_maps)).save(
+            os.path.join(directory, "index-maps"))
     meta = {"format_version": _FORMAT_VERSION, "task_type": model.task_type,
             "coordinates": {}, "config": config.to_dict() if config else None}
     for name, m in model.coordinates.items():
@@ -114,11 +141,155 @@ def save_game_model(
         json.dump(meta, f, indent=2)
 
 
+def _save_game_model_avro(model, directory, config, index_maps) -> None:
+    """Avro-format GAME model save (reference interchange artifacts)."""
+    from photon_ml_tpu.data.avro_io import (
+        write_glm_avro, write_latent_factors_avro, write_random_effect_avro,
+    )
+    os.makedirs(directory, exist_ok=True)
+    meta = {"format_version": _FORMAT_VERSION, "task_type": model.task_type,
+            "storage_format": "avro", "coordinates": {},
+            "config": config.to_dict() if config else None}
+    # every map actually used is persisted — including synthesized ones:
+    # Avro records drop zero coefficients, so WITHOUT the map a reload
+    # would rebuild a shrunken, shifted feature space
+    used_maps: Dict[str, IndexMap] = dict(index_maps or {})
+    for name, m in model.coordinates.items():
+        if isinstance(m, FixedEffectModel):
+            sub = os.path.join(directory, "fixed-effect", name)
+            os.makedirs(sub, exist_ok=True)
+            means = np.asarray(m.glm.coefficients.means)
+            imap = _shard_index_map(index_maps, m.feature_shard, len(means))
+            used_maps[m.feature_shard] = imap
+            var = m.glm.coefficients.variances
+            write_glm_avro(os.path.join(sub, "coefficients.avro"), name,
+                           model.task_type, means, imap,
+                           None if var is None else np.asarray(var))
+            meta["coordinates"][name] = {"kind": "fixed_effect",
+                                         "feature_shard": m.feature_shard}
+        elif isinstance(m, (RandomEffectModel, FactoredRandomEffectModel)):
+            factored = isinstance(m, FactoredRandomEffectModel)
+            re = m.to_random_effect_model() if factored else m
+            sub = os.path.join(directory, "random-effect", name)
+            os.makedirs(sub, exist_ok=True)
+            imap = _shard_index_map(index_maps, re.feature_shard,
+                                    re.global_dim)
+            used_maps[re.feature_shard] = imap
+            write_random_effect_avro(
+                os.path.join(sub, "coefficients.avro"), model.task_type,
+                re.entity_ids, np.asarray(re.coefficients), imap,
+                projection=re.projection,
+                variances=(None if re.variances is None
+                           else np.asarray(re.variances)))
+            if factored:
+                # the latent decomposition itself, as LatentFactorAvro
+                write_latent_factors_avro(
+                    os.path.join(sub, "latent-projection.avro"),
+                    [str(k) for k in range(m.latent_dim)],
+                    np.asarray(m.projection))
+                write_latent_factors_avro(
+                    os.path.join(sub, "latent-coefficients.avro"),
+                    [str(e) for e in np.asarray(m.entity_ids)],
+                    np.asarray(m.latent_coefficients))
+            meta["coordinates"][name] = {
+                "kind": "random_effect",
+                "random_effect_type": re.random_effect_type,
+                "feature_shard": re.feature_shard,
+                **({"materialized_from": "factored_random_effect"}
+                   if factored else {})}
+        elif isinstance(m, MatrixFactorizationModel):
+            from photon_ml_tpu.data.avro_io import write_latent_factors_avro
+            sub = os.path.join(directory, "matrix-factorization", name)
+            os.makedirs(sub, exist_ok=True)
+            write_latent_factors_avro(os.path.join(sub, "row-factors.avro"),
+                                      [str(i) for i in np.asarray(m.row_ids)],
+                                      np.asarray(m.row_factors))
+            write_latent_factors_avro(os.path.join(sub, "col-factors.avro"),
+                                      [str(i) for i in np.asarray(m.col_ids)],
+                                      np.asarray(m.col_factors))
+            meta["coordinates"][name] = {
+                "kind": "matrix_factorization",
+                "row_effect_type": m.row_effect_type,
+                "col_effect_type": m.col_effect_type,
+                "task_type": m.task_type}
+        else:
+            raise TypeError(f"unknown coordinate model type {type(m)}")
+    if used_maps:
+        IndexMapCollection(used_maps).save(
+            os.path.join(directory, "index-maps"))
+    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_model_index_maps(directory: str) -> Optional[Dict[str, IndexMap]]:
+    """The per-shard feature maps recorded at save time (needed to read
+    scoring/validation Avro data in the model's feature space)."""
+    path = os.path.join(directory, "index-maps")
+    if not os.path.isdir(path):
+        return None
+    return IndexMapCollection.load(path).shards
+
+
+def _load_game_model_avro(directory, meta):
+    from photon_ml_tpu.data.avro_io import (
+        read_glm_avro, read_latent_factors_avro, read_random_effect_avro,
+    )
+    task = meta["task_type"]
+    saved_maps = load_model_index_maps(directory) or {}
+    coords = {}
+    for name, info in meta["coordinates"].items():
+        if info["kind"] == "fixed_effect":
+            _, _, means, variances, _ = read_glm_avro(
+                os.path.join(directory, "fixed-effect", name,
+                             "coefficients.avro"),
+                saved_maps.get(info["feature_shard"]))
+            coeffs = Coefficients(
+                jnp.asarray(means),
+                None if variances is None else jnp.asarray(variances))
+            coords[name] = FixedEffectModel(model_for_task(task, coeffs),
+                                            info["feature_shard"])
+        elif info["kind"] == "random_effect":
+            e_ids, means, variances, imap = read_random_effect_avro(
+                os.path.join(directory, "random-effect", name,
+                             "coefficients.avro"),
+                saved_maps.get(info["feature_shard"]))
+            coords[name] = RandomEffectModel(
+                random_effect_type=info["random_effect_type"],
+                feature_shard=info["feature_shard"], task_type=task,
+                coefficients=jnp.asarray(means),
+                entity_ids=np.asarray(e_ids, dtype=object),
+                projection=None, global_dim=imap.size,
+                variances=(None if variances is None
+                           else jnp.asarray(variances)))
+        elif info["kind"] == "matrix_factorization":
+            sub = os.path.join(directory, "matrix-factorization", name)
+            row_ids, row_f = read_latent_factors_avro(
+                os.path.join(sub, "row-factors.avro"))
+            col_ids, col_f = read_latent_factors_avro(
+                os.path.join(sub, "col-factors.avro"))
+            coords[name] = MatrixFactorizationModel(
+                row_effect_type=info["row_effect_type"],
+                col_effect_type=info["col_effect_type"],
+                row_factors=jnp.asarray(row_f),
+                row_ids=np.asarray(row_ids, dtype=object),
+                col_factors=jnp.asarray(col_f),
+                col_ids=np.asarray(col_ids, dtype=object),
+                task_type=info.get("task_type", "none"))
+        else:
+            raise ValueError(
+                f"unknown avro coordinate kind {info['kind']!r}")
+    config = (GameTrainingConfig.from_dict(meta["config"])
+              if meta.get("config") else None)
+    return GameModel(coords, task), config
+
+
 def load_game_model(directory: str
                     ) -> Tuple[GameModel, Optional[GameTrainingConfig]]:
     """reference: ModelProcessingUtils.loadGameModelFromHDFS (scala:136-238)."""
     with open(os.path.join(directory, "model-metadata.json")) as f:
         meta = json.load(f)
+    if meta.get("storage_format") == "avro":
+        return _load_game_model_avro(directory, meta)
     task = meta["task_type"]
     coords = {}
     for name, info in meta["coordinates"].items():

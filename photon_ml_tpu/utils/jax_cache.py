@@ -14,6 +14,29 @@ _DEFAULT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
 
 
+class CompileTimeTracker:
+    """Accumulates real XLA backend-compile seconds via jax.monitoring.
+    With a warm persistent cache the backend compile never runs, so this
+    reads ~0 on the second identical invocation — the observable proof the
+    cache worked (VERDICT r3: report cold-vs-warm compile seconds)."""
+
+    _EVENT = "/jax/core/compile/backend_compile_duration"
+
+    def __init__(self):
+        self.seconds = 0.0
+        self.count = 0
+
+    def _on_event(self, name, duration, **kw):
+        if name == self._EVENT:
+            self.seconds += duration
+            self.count += 1
+
+    def install(self) -> "CompileTimeTracker":
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(self._on_event)
+        return self
+
+
 def enable_persistent_cache(path: str | None = None) -> str:
     """Idempotent; returns the cache directory in use."""
     import jax
@@ -23,7 +46,10 @@ def enable_persistent_cache(path: str | None = None) -> str:
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # persist EVERY program: the GAME path compiles dozens of small
+        # per-bucket programs whose compile times individually sit under
+        # any threshold but sum to the cold-start cost we want gone
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:  # older jax without these flags: cache is best-effort
         pass
     return path
