@@ -44,6 +44,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-columns", default=None,
                    help="Avro inputs: comma-separated random-effect id tags "
                         "to extract (top-level field or metadataMap key)")
+    p.add_argument("--input-date-range", default=None,
+                   help="restrict date-partitioned input to "
+                        "'yyyyMMdd-yyyyMMdd': reads "
+                        "<train-data>/daily/YYYY/MM/DD per day (reference: "
+                        "GameDriver.pathsForDateRange)")
+    p.add_argument("--input-days-ago", default=None,
+                   help="same as --input-date-range but as 'START-END' days "
+                        "ago (e.g. '90-1'); mutually exclusive with it")
+    p.add_argument("--validation-date-range", default=None,
+                   help="date range for the VALIDATION input's daily/ tree "
+                        "(each input resolves its own range, as in the "
+                        "reference)")
+    p.add_argument("--validation-days-ago", default=None,
+                   help="days-ago range for the validation input")
+    p.add_argument("--save-feature-stats", action="store_true",
+                   help="persist per-shard BasicStatisticalSummary to "
+                        "<output-dir>/feature-stats/<shard>.json (reference: "
+                        "Driver.calculateAndSaveFeatureShardStats)")
     p.add_argument("--task", default="logistic_regression",
                    choices=["logistic_regression", "linear_regression",
                             "poisson_regression", "smoothed_hinge_loss_linear_svm"])
@@ -160,17 +178,36 @@ def parse_feature_shard_map(arg):
     return m
 
 
-def _load_dataset(path: str, task: str, args=None, train_dataset=None):
+def _load_dataset(path: str, task: str, args=None, train_dataset=None,
+                  date_range=None, days_ago=None):
     """`train_dataset` pins a validation read to the TRAINING feature/entity
     spaces: separately-scanned Avro validation data would otherwise build
     its own sorted vocabularies and silently misalign columns with the
-    trained coefficients."""
+    trained coefficients.  `date_range`/`days_ago` expand the path's
+    daily/YYYY/MM/DD tree (each input resolves its own range, reference:
+    GameDriver.pathsForDateRange)."""
+    import glob as _glob
+
     from photon_ml_tpu.data import build_game_dataset, read_libsvm
     from photon_ml_tpu.data.game_data import load_game_dataset
     if path.endswith(".libsvm") or path.endswith(".txt"):
         x, y = read_libsvm(path)
         return build_game_dataset(y, {"global": x})
-    avro_paths = resolve_avro_paths(path)
+    if date_range or days_ago:
+        from photon_ml_tpu.data.date_range import paths_for_date_range
+        day_dirs = paths_for_date_range(path, date_range, days_ago)
+        # a day dir without .avro files (e.g. only a _SUCCESS marker) is
+        # skipped, matching the reference's errorOnMissing=false posture;
+        # only a range yielding NOTHING is an error
+        avro_paths = []
+        for d in day_dirs:
+            avro_paths.extend(sorted(_glob.glob(os.path.join(d, "*.avro"))))
+        if not avro_paths:
+            raise SystemExit(
+                f"no .avro files under any day directory of {path!r} "
+                "for the requested date range")
+    else:
+        avro_paths = resolve_avro_paths(path)
     if avro_paths is not None:
         # reference: AvroDataReader.readMerged + GameConverters — the
         # primary input path of the GAME training driver
@@ -253,9 +290,13 @@ def _run(args, log) -> int:
                                      RegularizationContext, RegularizationType)
 
     t0 = time.time()
-    train = _load_dataset(args.train_data, args.task, args)
+    train = _load_dataset(args.train_data, args.task, args,
+                          date_range=args.input_date_range,
+                          days_ago=args.input_days_ago)
     val = (_load_dataset(args.validation_data, args.task, args,
-                         train_dataset=train)
+                         train_dataset=train,
+                         date_range=args.validation_date_range,
+                         days_ago=args.validation_days_ago)
            if args.validation_data else None)
     ingest_s = time.time() - t0
     log.info("loaded train: %d rows, shards %s", train.num_rows,
@@ -275,6 +316,26 @@ def _run(args, log) -> int:
     validate_game_dataset(train, task, args.data_validation)
     if val is not None:
         validate_game_dataset(val, task, args.data_validation)
+
+    if args.save_feature_stats:
+        # reference: cli/game/training/Driver.calculateAndSaveFeatureShardStats
+        # (Driver.scala:297) — per-shard BasicStatisticalSummary persisted
+        # next to the job output
+        from photon_ml_tpu.data.stats import BasicStatisticalSummary
+        stats_dir = os.path.join(args.output_dir, "feature-stats")
+        os.makedirs(stats_dir, exist_ok=True)
+        for shard, x in train.feature_shards.items():
+            summary = (BasicStatisticalSummary.from_sparse(x, train.weights)
+                       if hasattr(x, "tocsr") and not isinstance(x, np.ndarray)
+                       else BasicStatisticalSummary.from_features(
+                           np.asarray(x), train.weights))
+            payload = summary.to_dict()
+            imap = (train.index_maps or {}).get(shard)
+            if imap is not None:
+                payload["feature_keys"] = [str(k) for k in imap.index_to_key]
+            with open(os.path.join(stats_dir, f"{shard}.json"), "w") as f:
+                json.dump(payload, f)
+        log.info("feature stats saved to %s", stats_dir)
 
     mesh = make_mesh_from_arg(args.mesh)
     if mesh is not None:

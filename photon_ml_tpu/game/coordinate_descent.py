@@ -15,6 +15,7 @@ that exists — arrays are device-resident for the whole fit.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import time
@@ -55,6 +56,35 @@ class ValidationSpec:
         return self.evaluator(s, dataset.response, dataset.weights)
 
 
+class PhaseTimings(dict):
+    """Accumulating span timer (reference: Timer/Timed spans at every driver
+    stage, photon-lib/.../util/Timer.scala:32-234 used ~30x).  Spans are
+    CONTIGUOUS over the descent loop so their sum accounts for the whole
+    fit wall-clock — an unattributed gap means an untimed stage, which is
+    exactly what round 3's bench suffered from."""
+
+    @contextlib.contextmanager
+    def span(self, label: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self[label] = self.get(label, 0.0) + time.perf_counter() - t0
+
+    def total(self) -> float:
+        return float(sum(self.values()))
+
+
+def _sync(*arrays) -> None:
+    """True device sync via a scalar readback.  Over the axon tunnel
+    block_until_ready returns BEFORE execution completes; only a
+    device->host readback orders the timeline, so every timing span that
+    launches device work ends with one (cost: one [1] DMA)."""
+    for a in arrays:
+        if a is not None and hasattr(a, "ravel"):
+            float(jnp.asarray(a).ravel()[-1])
+
+
 @dataclasses.dataclass
 class TrackerSummary:
     """Host-side per-solve record (reference: OptimizationStatesTracker
@@ -77,7 +107,11 @@ class CoordinateDescentResult:
     best_model: GameModel                  # best by first validation evaluator
     objective_history: List[float]         # after each coordinate update
     validation_history: Dict[str, List[float]]
-    timings: Dict[str, float]              # "it/coord" -> solve wall clock
+    # contiguous phase spans: "init/transfer", "init/score",
+    # "{it}/{coord}/solve|objective|validation", "{it}/checkpoint" (+ the
+    # estimator adds "build/coordinates"); their sum accounts for the whole
+    # fit wall clock
+    timings: Dict[str, float]
     # "it/coord" -> compact host-side solve summary (iterations, wall clock);
     # a full SolveResult per solve would pin [E, d]-sized device arrays for
     # the lifetime of every GameResult in a sweep
@@ -236,6 +270,7 @@ def run_coordinate_descent(
     checkpoint_dir: Optional[str] = None,
     resume: Optional[CheckpointState] = None,
     checkpoint_fingerprint: Optional[str] = None,
+    timings: Optional[PhaseTimings] = None,
 ) -> CoordinateDescentResult:
     """reference: CoordinateDescent.run/optimize (scala:57-385).
 
@@ -246,10 +281,14 @@ def run_coordinate_descent(
     scratch, SURVEY §5.3).  Use GameEstimator.fit(checkpoint_dir=...) for
     the integrated save-and-resume flow."""
     loss = TASK_LOSSES[task_type]
-    labels = jnp.asarray(dataset.response)
-    weights = None if dataset.weights is None else jnp.asarray(dataset.weights)
-    base_offsets = (jnp.zeros(dataset.num_rows) if dataset.offsets is None
-                    else jnp.asarray(dataset.offsets))
+    spans = PhaseTimings() if timings is None else timings
+    with spans.span("init/transfer"):
+        labels = jnp.asarray(dataset.response)
+        weights = (None if dataset.weights is None
+                   else jnp.asarray(dataset.weights))
+        base_offsets = (jnp.zeros(dataset.num_rows) if dataset.offsets is None
+                        else jnp.asarray(dataset.offsets))
+        _sync(labels, weights, base_offsets)
 
     def training_objective(total_scores, models) -> float:
         z = total_scores + base_offsets
@@ -275,18 +314,20 @@ def run_coordinate_descent(
                            "initial/warm-start models are superseded by the "
                            "checkpointed models")
         initial_models = resume.initial_models
-    models = {name: (initial_models or {}).get(name) or
-              coordinates[name].initial_model() for name in updating_sequence}
-    scores = {name: coordinates[name].score(models[name])
-              for name in updating_sequence}
-    total = sum(scores.values(), jnp.zeros(dataset.num_rows))
+    with spans.span("init/score"):
+        models = {name: (initial_models or {}).get(name) or
+                  coordinates[name].initial_model()
+                  for name in updating_sequence}
+        scores = {name: coordinates[name].score(models[name])
+                  for name in updating_sequence}
+        total = sum(scores.values(), jnp.zeros(dataset.num_rows))
+        _sync(total)
 
     objective_history: List[float] = list(
         resume.objective_history if resume is not None else [])
     validation_history: Dict[str, List[float]] = {
         s.name: list((resume.validation_history if resume is not None
                       else {}).get(s.name, [])) for s in validation_specs}
-    timings: Dict[str, float] = {}
     trackers: Dict[str, TrackerSummary] = {}
     best_model = GameModel(dict(models), task_type)
     best_metric: Optional[float] = None
@@ -300,34 +341,42 @@ def run_coordinate_descent(
     do_validation = validation_dataset is not None and validation_specs
     val_scores_by_coord = {}
     if do_validation:
-        val_scores_by_coord = {
-            name: models[name].score_dataset(validation_dataset)
-            for name in updating_sequence}
+        with spans.span("init/validation_score"):
+            val_scores_by_coord = {
+                name: models[name].score_dataset(validation_dataset)
+                for name in updating_sequence}
+            _sync(*val_scores_by_coord.values())
 
     for it in range(start_iteration, num_iterations):
         for name in updating_sequence:
-            t0 = time.perf_counter()
-            coord = coordinates[name]
-            # partial = full - own (reference line 186-193)
-            partial = total - scores[name]
-            models[name], tracker = coord.update(models[name], base_offsets + partial)
-            scores[name] = coord.score(models[name])
-            total = partial + scores[name]
-            timings[f"{it}/{name}"] = time.perf_counter() - t0
+            solve_key = f"{it}/{name}/solve"
+            with spans.span(solve_key):
+                coord = coordinates[name]
+                # partial = full - own (reference line 186-193)
+                partial = total - scores[name]
+                models[name], tracker = coord.update(
+                    models[name], base_offsets + partial)
+                scores[name] = coord.score(models[name])
+                total = partial + scores[name]
+                _sync(total)
             trackers[f"{it}/{name}"] = _summarize_tracker(
-                tracker, timings[f"{it}/{name}"])
+                tracker, spans[solve_key])
 
-            obj = training_objective(total, models)
+            with spans.span(f"{it}/{name}/objective"):
+                obj = training_objective(total, models)
             objective_history.append(obj)
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
-                        it, name, obj, timings[f"{it}/{name}"])
+                        it, name, obj, spans[solve_key])
 
             if do_validation:
-                val_scores_by_coord[name] = models[name].score_dataset(validation_dataset)
-                val_scores = sum(val_scores_by_coord.values(),
-                                 jnp.zeros(validation_dataset.num_rows))
-                for k, spec in enumerate(validation_specs):
-                    v = spec.evaluate(validation_dataset, val_scores)
+                with spans.span(f"{it}/{name}/validation"):
+                    val_scores_by_coord[name] = \
+                        models[name].score_dataset(validation_dataset)
+                    val_scores = sum(val_scores_by_coord.values(),
+                                     jnp.zeros(validation_dataset.num_rows))
+                    vals = [spec.evaluate(validation_dataset, val_scores)
+                            for spec in validation_specs]
+                for k, (spec, v) in enumerate(zip(validation_specs, vals)):
                     validation_history[spec.name].append(v)
                     logger.info("  validation %-24s = %.6g", spec.name, v)
                     if k == 0:  # best FULL model by first evaluator (ref 294-335)
@@ -336,11 +385,12 @@ def run_coordinate_descent(
                             best_model = GameModel(dict(models), task_type)
 
         if checkpoint_dir is not None:
-            _write_checkpoint(checkpoint_dir, it,
-                              GameModel(dict(models), task_type),
-                              objective_history, validation_history,
-                              best_model, best_metric,
-                              checkpoint_fingerprint)
+            with spans.span(f"{it}/checkpoint"):
+                _write_checkpoint(checkpoint_dir, it,
+                                  GameModel(dict(models), task_type),
+                                  objective_history, validation_history,
+                                  best_model, best_metric,
+                                  checkpoint_fingerprint)
 
     if (do_validation and resume is not None
             and start_iteration >= num_iterations
@@ -368,5 +418,5 @@ def run_coordinate_descent(
     return CoordinateDescentResult(
         model=final, best_model=best_model,
         objective_history=objective_history,
-        validation_history=validation_history, timings=timings,
+        validation_history=validation_history, timings=spans,
         trackers=trackers)

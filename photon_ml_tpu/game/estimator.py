@@ -124,7 +124,12 @@ class GameEstimator:
         failed Spark driver restarts the job from scratch, SURVEY §5.3)."""
         if self.emitter is not None:
             self.emitter.send_event(TrainingStartEvent(time.time()))
-        coords = self._build_coordinates(dataset)
+        from photon_ml_tpu.game.coordinate_descent import PhaseTimings
+        spans = PhaseTimings()
+        # coordinate construction includes the RE dataset bucketing — a real
+        # cost at corpus scale that round 3's phase timings never saw
+        with spans.span("build/coordinates"):
+            coords = self._build_coordinates(dataset)
         specs = (self._validation_specs(evaluator_specs)
                  if validation_dataset is not None else [])
         initial_models = (dict(initial_model.coordinates)
@@ -141,7 +146,7 @@ class GameEstimator:
             validation_dataset=validation_dataset, validation_specs=specs,
             initial_models=initial_models,
             checkpoint_dir=checkpoint_dir, resume=resume,
-            checkpoint_fingerprint=fingerprint)
+            checkpoint_fingerprint=fingerprint, timings=spans)
         validation = {name: hist[-1] for name, hist in
                       descent.validation_history.items() if hist}
         if self.emitter is not None:

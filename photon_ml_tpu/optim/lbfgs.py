@@ -57,6 +57,7 @@ class _State(NamedTuple):
     rho: jax.Array          # [m] 1/(s.y)
     num_pairs: jax.Array    # pairs stored so far
     f_small: jax.Array      # consecutive sub-tolerance f-changes
+    fg_count: jax.Array     # fused value+grad evaluations (= data passes)
     reason: jax.Array
     loss_hist: jax.Array
     gnorm_hist: jax.Array
@@ -202,6 +203,7 @@ def lbfgs(
         s_buf=jnp.zeros((m, d), dtype), y_buf=jnp.zeros((m, d), dtype),
         rho=jnp.zeros((m,), dtype), num_pairs=jnp.asarray(0, jnp.int32),
         f_small=jnp.asarray(0, jnp.int32),
+        fg_count=jnp.asarray(1, jnp.int32),  # the f0/g0 evaluation
         reason=jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
         loss_hist=jnp.full((max_iterations + 1,), nan).at[0].set(f0),
         gnorm_hist=jnp.full((max_iterations + 1,), nan).at[0].set(gnorm0),
@@ -257,7 +259,7 @@ def lbfgs(
 
         xt0 = trial(t0)
         ft0, gt0 = full_value(xt0)
-        t, _, ls_ok, x_new, f_new, g_new = lax.while_loop(
+        t, ls_n, ls_ok, x_new, f_new, g_new = lax.while_loop(
             ls_cond, ls_body,
             (jnp.asarray(t0, dtype), jnp.asarray(0, jnp.int32),
              armijo_ok(xt0, ft0), xt0, ft0, gt0))
@@ -303,7 +305,9 @@ def lbfgs(
         return _State(
             k=k, x=x_new, f=f_new, g=g_new,
             s_buf=s_buf, y_buf=y_buf, rho=rho, num_pairs=num_pairs,
-            f_small=f_small, reason=reason,
+            f_small=f_small,
+            fg_count=st.fg_count + 1 + ls_n,  # first trial + backtracks
+            reason=reason,
             loss_hist=st.loss_hist.at[k].set(f_new),
             gnorm_hist=st.gnorm_hist.at[k].set(gnorm_new),
             coef_hist=(None if st.coef_hist is None
@@ -318,7 +322,8 @@ def lbfgs(
     return SolveResult(x=st.x, value=st.f, gradient_norm=gnorm_final,
                        iterations=st.k, reason=reason,
                        loss_history=st.loss_hist, gnorm_history=st.gnorm_hist,
-                       coefficient_history=st.coef_hist)
+                       coefficient_history=st.coef_hist,
+                       fg_count=st.fg_count)
 
 
 def owlqn(value_and_grad: ValueAndGrad, x0: jax.Array, *, l1_weight,

@@ -55,6 +55,11 @@ class SolveResult(NamedTuple):
     # (each is a full data pass — the honest work count for throughput
     # accounting; the reference pays one treeAggregate per Hv, TRON.scala:301)
     hv_count: "jax.Array | None" = None
+    # LBFGS/OWLQN only: total fused value+gradient evaluations, INCLUDING
+    # the initial evaluation and every line-search backtrack trial — each is
+    # a full data pass, so throughput accounting must count them all (the
+    # round-3 bench treated line-search extras as free)
+    fg_count: "jax.Array | None" = None
 
     @property
     def converged(self) -> jax.Array:

@@ -301,3 +301,21 @@ def test_binary_downsampler_unbiased(rng):
     assert abs(kept_negative_weight - float(jnp.sum(neg))) / float(jnp.sum(neg)) < 0.05
     with pytest.raises(ValueError):
         binary_classification_downsample(key, labels, None, 1.5)
+
+
+def test_sparse_summary_matches_dense(rng):
+    """BasicStatisticalSummary.from_sparse == from_features on the
+    densified shard (the wide-regime stats path never densifies)."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.stats import BasicStatisticalSummary
+
+    x = sp.random(50, 12, density=0.3, format="csr", random_state=5)
+    w = rng.uniform(0.5, 2.0, 50)
+    for weights in (None, w):
+        a = BasicStatisticalSummary.from_sparse(x, weights)
+        b = BasicStatisticalSummary.from_features(x.toarray(), weights)
+        for field in ("mean", "variance", "num_nonzeros", "max", "min",
+                      "norm_l1", "norm_l2", "mean_abs"):
+            np.testing.assert_allclose(getattr(a, field), getattr(b, field),
+                                       rtol=1e-10, atol=1e-12, err_msg=field)

@@ -1,0 +1,96 @@
+"""Date-partitioned input resolution + per-shard feature-stats persistence
+(reference: GameDriver.pathsForDateRange, DateRange.fromDates/fromDaysAgo,
+Driver.calculateAndSaveFeatureShardStats)."""
+import datetime
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.date_range import (
+    parse_date_range, parse_days_ago, paths_for_date_range,
+)
+
+
+def _mk_days(tmp_path, days):
+    for y, m, d in days:
+        (tmp_path / "daily" / f"{y:04d}" / f"{m:02d}" / f"{d:02d}").mkdir(
+            parents=True)
+
+
+def test_parse_specs():
+    assert parse_date_range("20170101-20170103") == (
+        datetime.date(2017, 1, 1), datetime.date(2017, 1, 3))
+    with pytest.raises(ValueError, match="ends before"):
+        parse_date_range("20170105-20170101")
+    with pytest.raises(ValueError, match="yyyyMMdd"):
+        parse_date_range("2017-01-01")
+    today = datetime.date(2017, 1, 10)
+    assert parse_days_ago("9-7", today) == (
+        datetime.date(2017, 1, 1), datetime.date(2017, 1, 3))
+
+
+def test_paths_for_date_range(tmp_path):
+    _mk_days(tmp_path, [(2017, 1, 1), (2017, 1, 3), (2017, 2, 1)])
+    # missing middle day skipped; range endpoints inclusive
+    got = paths_for_date_range(str(tmp_path), "20170101-20170131")
+    assert [p.split("daily/")[1] for p in got] == ["2017/01/01", "2017/01/03"]
+    # both specs -> reference's IllegalArgument error
+    with pytest.raises(ValueError, match="only one format"):
+        paths_for_date_range(str(tmp_path), "20170101-20170102", "9-1")
+    # neither -> base dirs unchanged
+    assert paths_for_date_range(str(tmp_path)) == [str(tmp_path)]
+    # empty range -> error naming the daily dir
+    with pytest.raises(FileNotFoundError, match="No data folder"):
+        paths_for_date_range(str(tmp_path), "20180101-20180102")
+    # days-ago flavour
+    today = datetime.date(2017, 1, 4)
+    got2 = paths_for_date_range(str(tmp_path), days_ago="3-1", today=today)
+    assert [p.split("daily/")[1] for p in got2] == ["2017/01/01", "2017/01/03"]
+
+
+def test_cli_date_range_and_feature_stats(tmp_path, rng):
+    """CLI end-to-end: date-partitioned Avro ingest + per-shard feature
+    stats persisted next to the output."""
+    from photon_ml_tpu.data.avro_game import write_game_examples
+    from tests.test_avro_game import _bag_matrix
+    from tests.test_io_cli import _run_cli
+
+    n = 120
+    x, imap = _bag_matrix(rng, n, [(f"f{i}", "") for i in range(4)])
+    users = np.asarray([f"u{i % 5}" for i in range(n)])
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    days = [(2017, 1, 1), (2017, 1, 2), (2017, 1, 5)]
+    _mk_days(tmp_path, days + [(2017, 1, 31)])
+    # an empty day dir inside the range (e.g. only a _SUCCESS marker) is
+    # skipped, not fatal
+    (tmp_path / "daily" / "2017" / "01" / "31" / "_SUCCESS").write_text("")
+    third = n // 3
+    for k, (yy, mm, dd) in enumerate(days):
+        sl = slice(k * third, (k + 1) * third)
+        write_game_examples(
+            str(tmp_path / "daily" / f"{yy:04d}" / f"{mm:02d}" / f"{dd:02d}"
+                / "part.avro"),
+            y[sl], bags={"features": (x[sl], imap)},
+            id_values={"userId": users[sl]})
+
+    out_dir = str(tmp_path / "out")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", str(tmp_path),
+                  "--input-date-range", "20170101-20170131",
+                  "--id-columns", "userId",
+                  "--task", "logistic_regression",
+                  "--reg-weights", "1.0",
+                  "--save-feature-stats",
+                  "--output-dir", out_dir])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["train_rows"] == 3 * third  # empty Jan 31 dir skipped
+
+    stats_p = os.path.join(out_dir, "feature-stats", "global.json")
+    with open(stats_p) as f:
+        stats = json.load(f)
+    assert stats["count"] == 3 * third
+    assert len(stats["mean"]) == imap.size
+    assert len(stats["feature_keys"]) == imap.size

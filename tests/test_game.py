@@ -406,3 +406,27 @@ def test_checkpoint_corrupt_npz_falls_back_fresh(rng, tmp_path):
     res = GameEstimator(_config(task="logistic_regression", iters=1)).fit(
         ds, checkpoint_dir=ckpt)
     assert res.descent.total_iterations() > 0  # retrained, no crash
+
+
+def test_phase_timings_cover_fit_wall_clock(rng, tmp_path):
+    """Every stage of a fit is inside a named span (VERDICT r3 weak #1:
+    65% of the flagship bench wall-clock was unattributed): the span sum
+    must account for >=90% of the measured fit wall clock, and all span
+    families must be present."""
+    import time as _time
+
+    ds, _ = _dataset(rng, task="logistic")
+    rows = np.arange(ds.num_rows)
+    train, val = ds.subset(rows[:900]), ds.subset(rows[900:])
+    est = GameEstimator(_config(task="logistic_regression", iters=2))
+    t0 = _time.perf_counter()
+    res = est.fit(train, val, checkpoint_dir=str(tmp_path / "ckpt"))
+    wall = _time.perf_counter() - t0
+    spans = res.descent.timings
+    for family in ("build/coordinates", "init/transfer", "init/score",
+                   "0/fixed/solve", "0/fixed/objective",
+                   "0/fixed/validation", "0/checkpoint",
+                   "1/perUser/solve"):
+        assert family in spans, sorted(spans)
+    coverage = sum(spans.values()) / wall
+    assert coverage >= 0.9, (coverage, dict(spans))
