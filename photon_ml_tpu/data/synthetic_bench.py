@@ -163,3 +163,80 @@ def make_movielens_like(
 def movielens_shards(ml: MovieLensLike) -> Dict[str, np.ndarray]:
     return {"global": ml.x_global, "per_user": ml.x_user,
             "per_item": ml.x_item}
+
+
+def make_wide_sparse_logistic(n: int, d: int = 250_000, nnz: int = 64,
+                              seed: int = 77):
+    """Wide sparse logistic fixture: [n, d] binary CSR with `nnz` active
+    features per row (hashed-feature shape; reference: the >200k-feature
+    depth-switch regime, GameEstimator.scala:667-669) + labels from a
+    planted sparse GLM.  Column d-1 is the intercept."""
+    import scipy.sparse as sp
+    rng = np.random.default_rng(seed)
+    rows = np.repeat(np.arange(n), nnz)
+    cols = rng.integers(0, d - 1, size=n * nnz)
+    x = sp.coo_matrix((np.ones(n * nnz, np.float32), (rows, cols)),
+                      shape=(n, d)).tocsr()
+    x.sum_duplicates()
+    x.data[:] = 1.0                      # binary features, exact in bf16
+    icpt = sp.csr_matrix(np.ones((n, 1), np.float32))
+    x = sp.hstack([x[:, :d - 1], icpt]).tocsr()
+    w = (rng.normal(size=d) * (0.35 / np.sqrt(nnz))).astype(np.float64)
+    z = x.astype(np.float64) @ w
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    return x, y
+
+
+@dataclasses.dataclass
+class YahooLike:
+    """Yahoo!-Music-fixture-shaped GAME data: a WIDE sparse global shard
+    (the DriverTest e2e asserts 14,983 fixed-effect coefficients,
+    photon-client/src/integTest/.../DriverTest.scala:96-98) + narrow dense
+    per-user / per-item shards."""
+
+    user_ids: np.ndarray
+    item_ids: np.ndarray
+    response: np.ndarray
+    x_global: object          # [n, d_global] scipy CSR
+    x_user: np.ndarray        # [n, d_user] float32
+    x_item: np.ndarray        # [n, d_item] float32
+    num_users: int
+    num_items: int
+
+
+def make_yahoo_like(n_rows: int, d_global: int = 14_983, nnz_global: int = 24,
+                    num_users: int = 2_000, num_items: int = 10_000,
+                    d_user: int = 21, d_item: int = 21,
+                    seed: int = 23) -> YahooLike:
+    """FE (wide sparse) + per-user RE + per-item RE logistic fixture at the
+    Yahoo integration-test shape."""
+    import scipy.sparse as sp
+    rng = np.random.default_rng(seed)
+    n = int(n_rows)
+    user_ids = rng.integers(0, num_users, size=n).astype(np.int32)
+    item_ids = rng.integers(0, num_items, size=n).astype(np.int32)
+
+    rows = np.repeat(np.arange(n), nnz_global)
+    cols = rng.integers(0, d_global - 1, size=n * nnz_global)
+    xg = sp.coo_matrix((np.ones(n * nnz_global, np.float32), (rows, cols)),
+                       shape=(n, d_global)).tocsr()
+    xg.sum_duplicates()
+    xg.data[:] = 1.0
+    icpt = sp.csr_matrix(np.ones((n, 1), np.float32))
+    xg = sp.hstack([xg[:, :d_global - 1], icpt]).tocsr()
+
+    xu = rng.normal(size=(n, d_user)).astype(np.float32)
+    xu[:, -1] = 1.0
+    xi = rng.normal(size=(n, d_item)).astype(np.float32)
+    xi[:, -1] = 1.0
+
+    w_g = (rng.normal(size=d_global) * (0.4 / np.sqrt(nnz_global)))
+    w_u = rng.normal(size=(num_users, d_user)) * 0.5
+    w_i = rng.normal(size=(num_items, d_item)) * 0.3
+    z = xg.astype(np.float64) @ w_g
+    z = z + np.einsum("nd,nd->n", xu.astype(np.float64), w_u[user_ids])
+    z = z + np.einsum("nd,nd->n", xi.astype(np.float64), w_i[item_ids])
+    response = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    return YahooLike(user_ids=user_ids, item_ids=item_ids, response=response,
+                     x_global=xg, x_user=xu, x_item=xi,
+                     num_users=num_users, num_items=num_items)
