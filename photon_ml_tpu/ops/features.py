@@ -170,7 +170,11 @@ def matvec(x: FeatureMatrix, v: jax.Array) -> jax.Array:
         p = x._unflatten_coef(v)
         return jnp.sum((x.x @ p.T) * x.factors, axis=-1)
     if isinstance(x, PaddedSparse):
-        return jnp.sum(x.values * v[x.indices], axis=-1)
+        # indices are constructed in-bounds (from_dense/from_scipy), so the
+        # clamp/fill handling of the default gather is dead weight —
+        # promise_in_bounds halves the gather time on the TPU at wide d
+        g = v.at[x.indices].get(mode="promise_in_bounds")
+        return jnp.sum(x.values * g, axis=-1)
     return x @ v
 
 
@@ -179,8 +183,13 @@ def rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
     if isinstance(x, KroneckerDesign):
         return ((x.factors * u[:, None]).T @ x.x).reshape(-1)
     if isinstance(x, PaddedSparse):
+        # accumulate in the PROMOTED dtype: with bf16 feature storage the
+        # contrib product is f32 and the gradient must not round through a
+        # bf16 buffer (the solver state is f32)
         contrib = (x.values * u[:, None]).reshape(-1)
-        return jnp.zeros(x.num_cols, x.dtype).at[x.indices.reshape(-1)].add(contrib)
+        acc = jnp.promote_types(x.dtype, u.dtype)
+        return jnp.zeros(x.num_cols, acc).at[x.indices.reshape(-1)].add(
+            contrib, mode="promise_in_bounds")
     if is_sparse(x):
         # BCOO transpose-matvec: (u @ X) contracts over rows.
         return u @ x
@@ -196,7 +205,9 @@ def sq_rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
         return ((f2 * u[:, None]).T @ (x.x * x.x)).reshape(-1)
     if isinstance(x, PaddedSparse):
         contrib = (x.values * x.values * u[:, None]).reshape(-1)
-        return jnp.zeros(x.num_cols, x.dtype).at[x.indices.reshape(-1)].add(contrib)
+        acc = jnp.promote_types(x.dtype, u.dtype)
+        return jnp.zeros(x.num_cols, acc).at[x.indices.reshape(-1)].add(
+            contrib, mode="promise_in_bounds")
     if is_sparse(x):
         x2 = jsparse.BCOO((x.data * x.data, x.indices), shape=x.shape,
                           indices_sorted=x.indices_sorted, unique_indices=x.unique_indices)

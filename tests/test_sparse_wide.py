@@ -125,3 +125,24 @@ def test_sparse_validate_and_roundtrip(rng, tmp_path):
     ds3 = build_game_dataset(y, {"global": bad})
     with pytest.raises(DataValidationError, match="non-finite feature"):
         validate_game_dataset(ds3, "logistic_regression", "full")
+
+
+def test_bf16_values_accumulate_f32_gradient():
+    """bf16 feature storage must not round the gradient through a bf16
+    accumulator: rmatvec/sq_rmatvec promote to the solver dtype."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.ops.features import PaddedSparse, rmatvec, sq_rmatvec
+
+    rng = np.random.default_rng(3)
+    dense = (rng.uniform(size=(60, 20)) < 0.4).astype(np.float32)
+    u = jnp.asarray(rng.normal(size=60).astype(np.float32))
+    x32 = PaddedSparse.from_dense(dense)
+    x16 = PaddedSparse(x32.indices, x32.values.astype(jnp.bfloat16),
+                       x32.num_cols)
+    g16, g32 = rmatvec(x16, u), rmatvec(x32, u)
+    assert g16.dtype == jnp.float32
+    # binary features are exact in bf16, so the results must agree to f32
+    np.testing.assert_allclose(np.asarray(g16), np.asarray(g32), rtol=1e-6)
+    assert sq_rmatvec(x16, u).dtype == jnp.float32
