@@ -28,6 +28,12 @@ Reference-NLL capture ("x64 parity mode", VERDICT r2 item 1):
     default optimizer settings — the stand-in for the JVM double-precision
     baseline.  nll_rel_gap = (our_obj - ref_obj) / |ref_obj|.
 
+Phase timings: GAME entries carry the contiguous span breakdown
+(phase_timings_s) and phase_coverage = sum(spans)/fit_s.  On THIS rig the
+"build/coordinates" and "init/*" spans are dominated by host->device
+transfer over the ~5 MB/s accelerator tunnel (e.g. ~30s for ~150 MB of
+shard data); on directly-attached hardware that cost is bandwidth-trivial.
+
 Throughput accounting: examples/sec/chip counts one example per full data
 pass; LBFGS/OWLQN report their EXACT fused value+gradient evaluation count
 (initial eval + first trial + every line-search backtrack — tracked by the
