@@ -17,10 +17,12 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import functools
 import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -73,6 +75,17 @@ class PhaseTimings(dict):
 
     def total(self) -> float:
         return float(sum(self.values()))
+
+
+@functools.partial(jax.jit, static_argnames=("loss",))
+def _data_term(total_scores, base_offsets, labels, weights, *, loss):
+    """Weighted data-loss sum as ONE compiled program (a single device
+    round-trip per objective evaluation, which happens coords x iters times
+    per fit).  Module-level so the trace cache hits across fits of the same
+    shapes — per-fit closures would recompile on every grid combo."""
+    z = total_scores + base_offsets
+    l = loss.loss(z, labels)
+    return jnp.sum(l if weights is None else weights * l)
 
 
 def _sync(*arrays) -> None:
@@ -290,13 +303,15 @@ def run_coordinate_descent(
                         else jnp.asarray(dataset.offsets))
         _sync(labels, weights, base_offsets)
 
-    def training_objective(total_scores, models) -> float:
-        z = total_scores + base_offsets
-        l = loss.loss(z, labels)
-        data_term = float(jnp.sum(l if weights is None else weights * l))
-        reg_term = sum(coordinates[c].regularization_term(models[c])
-                       for c in models)
-        return data_term + reg_term
+    # per-coordinate regularization terms, recomputed ONLY for the updated
+    # coordinate (each term is a device readback; the reference recomputes
+    # every term per update via join+reduce, CoordinateDescent.scala:243-254)
+    reg_terms: Dict[str, float] = {}
+
+    def training_objective(total_scores) -> float:
+        return (float(_data_term(total_scores, base_offsets, labels,
+                                 weights, loss=loss))
+                + sum(reg_terms.values()))
 
     # init (reference: CoordinateDescent.run line 57-96); a resume record
     # overrides the initial models and restores histories + best tracking
@@ -321,6 +336,8 @@ def run_coordinate_descent(
         scores = {name: coordinates[name].score(models[name])
                   for name in updating_sequence}
         total = sum(scores.values(), jnp.zeros(dataset.num_rows))
+        reg_terms.update({name: coordinates[name].regularization_term(
+            models[name]) for name in updating_sequence})
         _sync(total)
 
     objective_history: List[float] = list(
@@ -363,7 +380,8 @@ def run_coordinate_descent(
                 tracker, spans[solve_key])
 
             with spans.span(f"{it}/{name}/objective"):
-                obj = training_objective(total, models)
+                reg_terms[name] = coord.regularization_term(models[name])
+                obj = training_objective(total)
             objective_history.append(obj)
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
                         it, name, obj, spans[solve_key])
