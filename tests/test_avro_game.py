@@ -329,3 +329,46 @@ def test_empty_avro_dir_is_explicit_error(tmp_path):
     with pytest.raises(SystemExit, match="matched no"):
         resolve_avro_paths(str(tmp_path / "nope-*.avro"))
     assert resolve_avro_paths("data.npz") is None
+
+
+def test_offsets_round_trip(tmp_path, rng, monkeypatch):
+    """Offsets survive the merged write->read (the residual-exchange
+    input; reference: GameDatum offset field)."""
+    n = 40
+    x, imap = _bag_matrix(rng, n, [("a", ""), ("b", "")])
+    off = rng.normal(size=n)
+    p = str(tmp_path / "off.avro")
+    write_game_examples(p, np.zeros(n), bags={"features": (x, imap)},
+                        offsets=off)
+    res = read_game_examples([p], {"g": ["features"]})
+    np.testing.assert_allclose(res.dataset.offsets, off, rtol=1e-12)
+    # python fallback parity
+    monkeypatch.setattr(avro_native, "read_columnar", lambda p, **kw: None)
+    res2 = read_game_examples([p], {"g": ["features"]})
+    np.testing.assert_allclose(res2.dataset.offsets, off, rtol=1e-12)
+
+
+def test_scoring_avro_against_model_without_index_maps_errors(tmp_path, rng):
+    """A model saved without index maps cannot resolve Avro scoring data
+    into its feature space; the scoring CLI must hard-error, not silently
+    misalign columns."""
+    from tests.test_game import _config, _dataset
+    from tests.test_io_cli import _run_cli
+    from photon_ml_tpu.game import GameEstimator
+    from photon_ml_tpu.models.io import save_game_model
+
+    ds, _ = _dataset(rng, n=200, task="logistic")
+    res = GameEstimator(_config(task="logistic_regression", iters=1)).fit(ds)
+    model_dir = str(tmp_path / "m")
+    save_game_model(res.model, model_dir)  # no index_maps recorded
+
+    n = 20
+    x, imap = _bag_matrix(rng, n, [("a", "")])
+    data_p = str(tmp_path / "score.avro")
+    write_game_examples(data_p, np.zeros(n), bags={"features": (x, imap)},
+                        id_values={"userId": np.asarray(["u0"] * n)})
+    r = _run_cli("photon_ml_tpu.cli.score",
+                 ["--model-dir", model_dir, "--data", data_p,
+                  "--output", str(tmp_path / "s.avro"), "--format", "avro"])
+    assert r.returncode != 0
+    assert "records no index-maps" in (r.stderr + r.stdout)
