@@ -22,7 +22,15 @@ import numpy as np
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="photon-ml-tpu-diagnose")
     p.add_argument("--model-dir", required=True)
-    p.add_argument("--data", required=True, help=".npz GameDataset or .libsvm")
+    p.add_argument("--data", required=True,
+                   help=".npz GameDataset, .libsvm, or Avro input (file, "
+                        "directory, or glob; resolved in the MODEL's "
+                        "feature/entity spaces like cli.score)")
+    p.add_argument("--feature-shard-map", default=None,
+                   help="Avro inputs: JSON (inline or @file) shard -> bags "
+                        "merge map (see cli.train)")
+    p.add_argument("--id-columns", default=None,
+                   help="Avro inputs: comma-separated id tags to extract")
     p.add_argument("--output-dir", required=True)
     p.add_argument("--coordinate", default=None,
                    help="fixed-effect coordinate to analyze in depth "
@@ -40,7 +48,6 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
 
-    from photon_ml_tpu.cli.train import _load_dataset
     from photon_ml_tpu.data.stats import BasicStatisticalSummary
     from photon_ml_tpu.diagnostics import (
         DiagnosticReport, bootstrap_training, evaluate_scores,
@@ -53,7 +60,13 @@ def main(argv=None) -> int:
     from photon_ml_tpu.ops import TASK_LOSSES
 
     model, config = load_game_model(args.model_dir)
-    ds = _load_dataset(args.data, model.task_type)
+    # Avro inputs resolve in the MODEL's feature/entity spaces (the scoring
+    # loader pins index maps and errors when the model records none —
+    # misaligned columns would silently corrupt every diagnostic)
+    from photon_ml_tpu.cli.score import (_load_scoring_data,
+                                         require_fully_labeled)
+    ds, _uids = _load_scoring_data(args, model, args.model_dir)
+    require_fully_labeled(ds, "diagnostics")
     task = model.task_type
     loss = TASK_LOSSES[task]
 

@@ -91,6 +91,19 @@ def _load_scoring_data(args, model, model_dir):
     return result.dataset, result.uids
 
 
+def require_fully_labeled(ds, purpose: str) -> None:
+    """Shared labeled-data gate for score/diagnose: ANY unlabeled row would
+    silently NaN-poison metrics, so partial labels are an error too."""
+    nan = np.isnan(np.asarray(ds.response))
+    if nan.all():
+        raise SystemExit(f"{purpose} requires labeled data (the input has "
+                         "no response column)")
+    if nan.any():
+        raise SystemExit(
+            f"{purpose} requires a response for every record; "
+            f"{int(nan.sum())} of {ds.num_rows} rows are unlabeled")
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -132,9 +145,7 @@ def main(argv=None) -> int:
               "compile_s": round(compile_tracker.seconds, 2),
               "evaluation": {}}
     if args.evaluators:
-        if not has_response:
-            raise SystemExit("--evaluators requires labeled scoring data "
-                             "(the input has no response column)")
+        require_fully_labeled(ds, "--evaluators")
         total = scores + (ds.offsets if ds.offsets is not None else 0.0)
         for spec in args.evaluators.split(","):
             ev, group = parse_evaluator(spec)

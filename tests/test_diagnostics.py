@@ -240,3 +240,59 @@ def test_diagnose_cli_end_to_end(rng, tmp_path):
     assert "fitting" in report
     md = (tmp_path / "diag" / "report.md").read_text()
     assert "Hosmer-Lemeshow" in md and "Learning curves" in md
+
+
+def test_diagnose_cli_avro_input(rng, tmp_path):
+    """Avro diagnostics data resolves in the MODEL's feature space (same
+    pinning as scoring) and produces the same metrics as the npz path."""
+    from photon_ml_tpu.cli.diagnose import main
+    from photon_ml_tpu.data.avro_game import write_game_examples
+    from photon_ml_tpu.data.game_data import save_game_dataset
+    from photon_ml_tpu.data.index_map import build_index_map
+    from photon_ml_tpu.game import (
+        FixedEffectCoordinateConfig, GameEstimator, GameTrainingConfig,
+        GLMOptimizationConfig,
+    )
+    from photon_ml_tpu.models.io import save_game_model
+
+    n = 400
+    imap = build_index_map([(f"f{i}", "") for i in range(4)])
+    # values exact in BOTH f32 (avro read) and f64 (npz): generate at f32
+    # precision, store f64
+    x = np.zeros((n, imap.size), np.float64)
+    x[:, :-1] = rng.normal(size=(n, 4)).astype(np.float32).astype(np.float64)
+    x[:, -1] = 1.0
+    w = rng.normal(size=imap.size)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w)))).astype(float)
+
+    from photon_ml_tpu.data import build_game_dataset
+    ds = build_game_dataset(y, {"global": x}, index_maps={"global": imap})
+    cfg = GameTrainingConfig(
+        "logistic_regression",
+        {"fixed": FixedEffectCoordinateConfig(
+            "global", GLMOptimizationConfig(regularization=L2,
+                                            regularization_weight=0.01))},
+        ["fixed"])
+    res = GameEstimator(cfg).fit(ds)
+    save_game_model(res.model, str(tmp_path / "model"), config=cfg,
+                    index_maps=ds.index_maps)
+
+    avro_p = str(tmp_path / "data.avro")
+    write_game_examples(avro_p, y, bags={"features": (x, imap)})
+    rc = main(["--model-dir", str(tmp_path / "model"),
+               "--data", avro_p,
+               "--output-dir", str(tmp_path / "diag-avro"),
+               "--skip-bootstrap", "--skip-fitting"])
+    assert rc == 0
+    rep_avro = json.loads((tmp_path / "diag-avro" / "report.json").read_text())
+
+    save_game_dataset(ds, str(tmp_path / "data.npz"))
+    rc = main(["--model-dir", str(tmp_path / "model"),
+               "--data", str(tmp_path / "data.npz"),
+               "--output-dir", str(tmp_path / "diag-npz"),
+               "--skip-bootstrap", "--skip-fitting"])
+    assert rc == 0
+    rep_npz = json.loads((tmp_path / "diag-npz" / "report.json").read_text())
+    for k, v in rep_npz["metrics"].items():
+        np.testing.assert_allclose(rep_avro["metrics"][k], v, rtol=1e-5,
+                                   err_msg=k)
