@@ -31,6 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "merge map (see cli.train)")
     p.add_argument("--id-columns", default=None,
                    help="Avro inputs: comma-separated id tags to extract")
+    p.add_argument("--input-columns", default=None,
+                   help="Avro inputs: JSON remap of response/offset/weight/"
+                        "uid column names (see cli.train)")
     p.add_argument("--output-dir", required=True)
     p.add_argument("--coordinate", default=None,
                    help="fixed-effect coordinate to analyze in depth "

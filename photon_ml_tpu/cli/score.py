@@ -35,6 +35,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "merge map (see cli.train)")
     p.add_argument("--id-columns", default=None,
                    help="Avro inputs: comma-separated id tags to extract")
+    p.add_argument("--input-columns", default=None,
+                   help="Avro inputs: JSON remap of response/offset/weight/"
+                        "uid column names (see cli.train)")
     p.add_argument("--evaluators", default=None)
     p.add_argument("--predict", action="store_true",
                    help="also emit mean predictions (inverse link; npz only)")
@@ -51,6 +54,7 @@ def _load_scoring_data(args, model, model_dir):
     model's index maps; unseen entities score through the fixed effect
     only).  Returns (dataset, uids or None)."""
     from photon_ml_tpu.cli.train import (_load_dataset, parse_feature_shard_map,
+                                         parse_input_columns,
                                          resolve_avro_paths)
     avro_paths = resolve_avro_paths(args.data)
     if avro_paths is None:
@@ -85,6 +89,7 @@ def _load_scoring_data(args, model, model_dir):
     result = read_game_examples(
         avro_paths, parse_feature_shard_map(args.feature_shard_map),
         id_columns=id_cols,
+        columns=parse_input_columns(getattr(args, "input_columns", None)),
         index_maps=index_maps,
         entity_vocabs=entity_vocabs or None,
         require_response=False)

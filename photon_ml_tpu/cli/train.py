@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-columns", default=None,
                    help="Avro inputs: comma-separated random-effect id tags "
                         "to extract (top-level field or metadataMap key)")
+    p.add_argument("--input-columns", default=None,
+                   help="Avro inputs: JSON remapping of input column names, "
+                        "e.g. '{\"response\": \"label\", \"weight\": \"w\"}' "
+                        "(reference: InputColumnsNames; keys: response, "
+                        "offset, weight, uid)")
     p.add_argument("--input-date-range", default=None,
                    help="restrict date-partitioned input to "
                         "'yyyyMMdd-yyyyMMdd': reads "
@@ -161,16 +166,40 @@ def resolve_avro_paths(path: str):
     return None
 
 
+def _load_json_arg(arg: str):
+    """Shared 'inline JSON or @file' convention for CLI JSON flags."""
+    if arg.startswith("@"):
+        with open(arg[1:]) as f:
+            return json.loads(f.read())
+    return json.loads(arg)
+
+
+def parse_input_columns(arg):
+    """JSON column remap -> InputColumnNames (reference: InputColumnsNames
+    remappable response/offset/weight/uid names)."""
+    from photon_ml_tpu.data.game_data import InputColumnNames
+    if arg is None:
+        return InputColumnNames()
+    import dataclasses as _dc
+    m = _load_json_arg(arg)
+    allowed = {f.name for f in _dc.fields(InputColumnNames)}
+    if not isinstance(m, dict) or not all(
+            isinstance(v, str) and v for v in m.values()):
+        raise SystemExit("--input-columns must be a JSON object mapping "
+                         "column roles to non-empty string column names")
+    bad = set(m) - allowed
+    if bad:
+        raise SystemExit(f"--input-columns: unknown keys {sorted(bad)} "
+                         f"(allowed: {sorted(allowed)})")
+    return InputColumnNames(**m)
+
+
 def parse_feature_shard_map(arg):
     """JSON inline or @file -> {shard: [bags]}; default single-shard merge
     of the TrainingExampleAvro 'features' bag."""
     if arg is None:
         return {"global": ["features"]}
-    text = arg
-    if arg.startswith("@"):
-        with open(arg[1:]) as f:
-            text = f.read()
-    m = json.loads(text)
+    m = _load_json_arg(arg)
     if not isinstance(m, dict) or not all(
             isinstance(v, list) and v for v in m.values()):
         raise SystemExit("--feature-shard-map must be a JSON object mapping "
@@ -218,6 +247,8 @@ def _load_dataset(path: str, task: str, args=None, train_dataset=None,
         result = read_game_examples(
             avro_paths, shard_map,
             id_columns=[c for c in id_cols.split(",") if c],
+            columns=parse_input_columns(
+                getattr(args, "input_columns", None) if args else None),
             index_maps=(train_dataset.index_maps or None
                         if train_dataset is not None else None),
             entity_vocabs=(train_dataset.entity_vocabs or None

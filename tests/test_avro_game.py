@@ -372,3 +372,77 @@ def test_scoring_avro_against_model_without_index_maps_errors(tmp_path, rng):
                   "--output", str(tmp_path / "s.avro"), "--format", "avro"])
     assert r.returncode != 0
     assert "records no index-maps" in (r.stderr + r.stdout)
+
+
+def test_input_column_remap_through_cli(tmp_path, rng):
+    """--input-columns remaps response/weight names (reference:
+    InputColumnsNames remappable columns)."""
+    from photon_ml_tpu.cli.train import parse_input_columns
+    from photon_ml_tpu.data.avro_codec import write_container
+    from photon_ml_tpu.data.game_data import InputColumnNames
+    from tests.test_io_cli import _run_cli
+
+    cols = parse_input_columns('{"response": "target", "weight": "wgt"}')
+    assert cols.response == "target" and cols.weight == "wgt"
+    assert cols.offset == "offset"  # unremapped fields keep defaults
+    with pytest.raises(SystemExit, match="unknown keys"):
+        parse_input_columns('{"label_col": "x"}')
+
+    n = 60
+    x, imap = _bag_matrix(rng, n, [("a", ""), ("b", "")], density=1.0)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    w = rng.uniform(0.5, 2.0, n)
+    schema = {"name": "Remapped", "type": "record", "fields": [
+        {"name": "target", "type": "double"},
+        {"name": "wgt", "type": ["null", "double"], "default": None},
+        {"name": "features", "type": {"type": "array", "items": {
+            "name": "FeatureAvro", "type": "record", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": "string"},
+                {"name": "value", "type": "double"}]}}},
+    ]}
+    recs = [{"target": float(y[i]), "wgt": float(w[i]),
+             "features": [{"name": "a", "term": "", "value": float(x[i, 0])},
+                          {"name": "b", "term": "", "value": float(x[i, 1])}]}
+            for i in range(n)]
+    p = str(tmp_path / "remap.avro")
+    write_container(p, schema, recs)
+
+    res = read_game_examples([p], {"g": ["features"]},
+                             columns=InputColumnNames(response="target",
+                                                      weight="wgt"))
+    np.testing.assert_allclose(res.dataset.response, y)
+    np.testing.assert_allclose(res.dataset.weights, w)
+
+    out_dir = str(tmp_path / "out")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", p, "--task", "logistic_regression",
+                  "--input-columns", '{"response": "target", "weight": "wgt"}',
+                  "--reg-weights", "1.0", "--output-dir", out_dir])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["train_rows"] == n
+
+
+def test_remapped_response_typo_errors(tmp_path, rng):
+    """An explicitly remapped response name that is absent must error, not
+    silently fall back to 'label' (which could be a different column)."""
+    from photon_ml_tpu.data.game_data import InputColumnNames
+    n = 20
+    x, imap = _bag_matrix(rng, n, [("a", "")])
+    p = str(tmp_path / "t.avro")
+    write_game_examples(p, np.zeros(n), bags={"features": (x, imap)})
+    with pytest.raises(ValueError, match="remapped response column 'taget'"):
+        read_game_examples([p], {"g": ["features"]},
+                           columns=InputColumnNames(response="taget"))
+    # python fallback: same contract
+    import photon_ml_tpu.data.avro_native as an
+    orig = an.read_columnar
+    an.read_columnar = lambda p_, **kw: None
+    try:
+        with pytest.raises(ValueError,
+                           match="remapped response column 'taget'"):
+            read_game_examples([p], {"g": ["features"]},
+                               columns=InputColumnNames(response="taget"))
+    finally:
+        an.read_columnar = orig
