@@ -80,14 +80,20 @@ def _load_scoring_data(args, model, model_dir):
                 and hasattr(m, "entity_ids"):
             add_tag(m.random_effect_type, m.entity_ids)
     index_maps = load_model_index_maps(model_dir)
-    if index_maps is None:
+    shard_map = parse_feature_shard_map(args.feature_shard_map)
+    missing = sorted(set(shard_map) - set(index_maps or {}))
+    if missing:
+        # a PARTIALLY covered shard map is the same failure as no maps at
+        # all: read_game_examples would scan a fresh vocabulary for the
+        # uncovered shard and columns would silently misalign with the model
         raise SystemExit(
-            f"model at {model_dir!r} records no index-maps directory, so "
-            "Avro scoring data cannot be resolved into the model's feature "
-            "space (columns would silently misalign). Re-save the model "
-            "with index maps, or score from an npz GameDataset instead.")
+            f"model at {model_dir!r} records no saved index map for feature "
+            f"shard(s) {missing} named in --feature-shard-map, so Avro "
+            "scoring data cannot be resolved into the model's feature space "
+            "(columns would silently misalign). Re-save the model with index "
+            "maps for every shard, or score from an npz GameDataset instead.")
     result = read_game_examples(
-        avro_paths, parse_feature_shard_map(args.feature_shard_map),
+        avro_paths, shard_map,
         id_columns=id_cols,
         columns=parse_input_columns(getattr(args, "input_columns", None)),
         index_maps=index_maps,

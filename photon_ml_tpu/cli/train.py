@@ -244,6 +244,17 @@ def _load_dataset(path: str, task: str, args=None, train_dataset=None,
         shard_map = parse_feature_shard_map(
             getattr(args, "feature_shard_map", None) if args else None)
         id_cols = (getattr(args, "id_columns", None) or "") if args else ""
+        if train_dataset is not None and not train_dataset.index_maps:
+            # a libsvm/npz training input carries no (name,term) index maps,
+            # so an Avro validation read has nothing to pin its columns to —
+            # the scanned vocabulary would silently misalign with the
+            # trained coefficients
+            raise SystemExit(
+                "Avro validation data requires the training input to carry "
+                "feature index maps (train from Avro, or from an npz "
+                "GameDataset saved with index maps); the training dataset "
+                "has none, so validation columns cannot be aligned with the "
+                "trained model's feature space")
         result = read_game_examples(
             avro_paths, shard_map,
             id_columns=[c for c in id_cols.split(",") if c],

@@ -170,6 +170,18 @@ def _save_game_model_avro(model, directory, config, index_maps) -> None:
         elif isinstance(m, (RandomEffectModel, FactoredRandomEffectModel)):
             factored = isinstance(m, FactoredRandomEffectModel)
             re = m.to_random_effect_model() if factored else m
+            if re.projection_matrix is not None:
+                # random-projection RE: Avro records key coefficients by
+                # ORIGINAL-space feature; write P^T c, not the projected-space
+                # slots (which would alias local slot j to feature j).
+                # Projected-space variances have no per-feature meaning and
+                # are dropped, like the factored path.
+                re = RandomEffectModel(
+                    random_effect_type=re.random_effect_type,
+                    feature_shard=re.feature_shard, task_type=re.task_type,
+                    coefficients=re.global_coefficients(),
+                    entity_ids=re.entity_ids, projection=None,
+                    global_dim=re.global_dim)
             sub = os.path.join(directory, "random-effect", name)
             os.makedirs(sub, exist_ok=True)
             imap = _shard_index_map(index_maps, re.feature_shard,

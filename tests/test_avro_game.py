@@ -273,6 +273,27 @@ def test_validation_read_pinned_to_training_spaces(tmp_path, rng):
     assert (val.entity_indices["userId"][:9] >= 0).all()
 
 
+def test_non_nullable_uid_passthrough(tmp_path, rng):
+    """A schema with a plain (non-union) string uid must still pass uids
+    through the native decode path — it decodes as a StrColumn with no
+    #present companion (ADVICE r4 finding)."""
+    n = 8
+    schema = game_example_schema(["features"], [])
+    for f in schema["fields"]:
+        if f["name"] == "uid":
+            f["type"] = "string"
+            f.pop("default", None)
+    from photon_ml_tpu.data.avro_codec import write_container
+    recs = [{"uid": f"row{i}", "response": 1.0, "weight": None,
+             "offset": None, "metadataMap": None,
+             "features": [{"name": "a", "term": "", "value": 1.0}]}
+            for i in range(n)]
+    p = str(tmp_path / "uid.avro")
+    write_container(p, schema, recs)
+    res = read_game_examples([p], {"g": ["features"]})
+    assert res.uids == [f"row{i}" for i in range(n)]
+
+
 def test_null_response_rejected_for_training(tmp_path, rng):
     n = 6
     x, imap = _bag_matrix(rng, n, [("a", "")])
@@ -371,7 +392,59 @@ def test_scoring_avro_against_model_without_index_maps_errors(tmp_path, rng):
                  ["--model-dir", model_dir, "--data", data_p,
                   "--output", str(tmp_path / "s.avro"), "--format", "avro"])
     assert r.returncode != 0
-    assert "records no index-maps" in (r.stderr + r.stdout)
+    assert "records no saved index map" in (r.stderr + r.stdout)
+
+
+def test_scoring_avro_with_partial_index_map_coverage_errors(tmp_path, rng):
+    """Maps covering only SOME requested shards are the same silent
+    misalignment hazard as none at all (ADVICE r4 finding): the scoring CLI
+    must name the uncovered shard and exit."""
+    import pytest
+    from photon_ml_tpu.cli import score as score_cli
+    from tests.test_game import _config, _dataset
+    from photon_ml_tpu.game import GameEstimator
+    from photon_ml_tpu.models.io import save_game_model
+
+    ds, _ = _dataset(rng, n=200, task="logistic")
+    res = GameEstimator(_config(task="logistic_regression", iters=1)).fit(ds)
+    model_dir = str(tmp_path / "m")
+    save_game_model(res.model, model_dir,
+                    index_maps={"global": build_index_map(
+                        [(f"g{i}", "") for i in range(7)])})
+
+    n = 20
+    xg, gm = _bag_matrix(rng, n, [("a", "")])
+    xu, um = _bag_matrix(rng, n, [("u", "")])
+    data_p = str(tmp_path / "score.avro")
+    write_game_examples(data_p, np.zeros(n),
+                        bags={"features": (xg, gm), "userFeatures": (xu, um)},
+                        id_values={"userId": np.asarray(["u0"] * n)})
+    argv = ["--model-dir", model_dir, "--data", data_p,
+            "--output", str(tmp_path / "s.npz"),
+            "--feature-shard-map",
+            json.dumps({"global": ["features"], "per_user": ["userFeatures"]})]
+    with pytest.raises(SystemExit) as ei:
+        score_cli.main(argv)
+    assert "per_user" in str(ei.value)
+
+
+def test_avro_validation_without_training_index_maps_errors(tmp_path, rng):
+    """libsvm/npz training input carries no index maps; pairing it with an
+    Avro validation set must be a hard error, not silent misalignment
+    (ADVICE r4 finding)."""
+    import pytest
+    from photon_ml_tpu.cli.train import _load_dataset
+    from photon_ml_tpu.data import build_game_dataset
+
+    train = build_game_dataset(np.zeros(10),
+                               {"global": rng.normal(size=(10, 3))})
+    assert not train.index_maps
+    n = 5
+    x, imap = _bag_matrix(rng, n, [("a", "")])
+    p = str(tmp_path / "val.avro")
+    write_game_examples(p, np.zeros(n), bags={"features": (x, imap)})
+    with pytest.raises(SystemExit, match="index maps"):
+        _load_dataset(p, "linear_regression", None, train_dataset=train)
 
 
 def test_input_column_remap_through_cli(tmp_path, rng):

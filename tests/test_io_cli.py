@@ -266,6 +266,38 @@ def test_factored_and_mf_avro_roundtrip(tmp_path, rng):
     assert (loaded.coordinates["mf"].col_ids == mf.col_ids).all()
 
 
+def test_random_projection_re_avro_roundtrip(tmp_path, rng):
+    """Avro save of a random-projection RE model writes ORIGINAL-space
+    coefficients (P^T c), not projected-space slots keyed as feature j
+    (ADVICE r4 high finding)."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.models.game import GameModel, RandomEffectModel
+    E, k, d = 5, 3, 8
+    re = RandomEffectModel(
+        random_effect_type="userId", feature_shard="per_user",
+        task_type="linear_regression",
+        coefficients=jnp.asarray(rng.normal(size=(E, k)), jnp.float32),
+        entity_ids=np.asarray([f"u{i}" for i in range(E)]),
+        projection=None, global_dim=d,
+        variances=jnp.ones((E, k)),
+        projection_matrix=jnp.asarray(rng.normal(size=(k, d)), jnp.float32))
+    model = GameModel({"perUser": re}, "linear_regression")
+    d_avro = str(tmp_path / "avro")
+    save_game_model(model, d_avro, format="avro")
+    loaded, _ = load_game_model(d_avro)
+    got = loaded.coordinates["perUser"]
+    assert got.projection_matrix is None
+    np.testing.assert_allclose(np.asarray(got.coefficients),
+                               np.asarray(re.global_coefficients()),
+                               atol=1e-5)
+    ds = build_game_dataset(
+        np.zeros(3), {"per_user": rng.normal(size=(3, d))},
+        entity_ids={"userId": np.asarray(["u0", "u3", "nope"])})
+    np.testing.assert_allclose(np.asarray(loaded.score_dataset(ds)),
+                               np.asarray(model.score_dataset(ds)),
+                               atol=1e-5)
+
+
 def test_cli_score_avro_output_and_input(tmp_path, rng):
     """Train from Avro, save the model as Avro, score Avro data back out to
     ScoringResultAvro — the full reference-format loop."""
