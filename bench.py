@@ -232,12 +232,14 @@ def glm_entry(task, x_np, y_np, opt_cfg, reg, lam, l1, l2, label, reps=3,
            f":l1={l1}:l2={l2}:box={bounds}"
            f":fp={_data_fingerprint(x_np, y_np)}")
     cached = _ref_cache_get_raw(key)
-    if cached is not None:
-        ref_nll = cached["ref_nll"]
+    if cached is not None and "ref_s" in cached:
+        # the cached CPU solve time keeps the TPU-vs-CPU wall-clock ratio in
+        # the entry even when the optimum itself is served from cache
+        ref_nll, ref_s = cached["ref_nll"], cached["ref_s"]
     else:
         _, ref_nll = scipy_ref(task, x64, y64, l1=l1, l2=l2, bounds=bounds)
-        _ref_cache_put_raw(key, {"ref_nll": ref_nll})
-    ref_s = time.perf_counter() - t0
+        ref_s = time.perf_counter() - t0
+        _ref_cache_put_raw(key, {"ref_nll": ref_nll, "ref_s": round(ref_s, 2)})
     our_nll = np_objective_value(task, x64, y64, w, l1, l2)
     n = x_np.shape[0]
     iters = int(res.iterations)
@@ -829,6 +831,54 @@ def bench_config7():
 
 # --------------------------------------------------------------------------
 
+def warm_ref_cache():
+    """Compute every GLM config's float64 CPU reference (optimum + solve
+    time) OUTSIDE the measured suite, so bench runs always serve the
+    scipy references — including their wall-clock — from cache.  Safe to
+    re-run: entries that already carry ref_s are skipped."""
+    from photon_ml_tpu.data.synthetic_bench import (make_a1a_like,
+                                                    make_wide_sparse_logistic)
+
+    def ensure(task, x, y, data_seed, l1, l2, bounds, label):
+        key = (f"scipy:{task}:seed{data_seed}:{x.shape[0]}x{x.shape[1]}"
+               f":l1={l1}:l2={l2}:box={bounds}"
+               f":fp={_data_fingerprint(x, y)}")
+        cached = _ref_cache_get_raw(key)
+        if cached is not None and "ref_s" in cached:
+            _log(f"warm-ref: {label} already warm (ref_s={cached['ref_s']})")
+            return
+        t0 = time.perf_counter()
+        _, ref_nll = scipy_ref(task, _as_f64(x), y.astype(np.float64),
+                               l1=l1, l2=l2, bounds=bounds)
+        ref_s = time.perf_counter() - t0
+        if cached is not None and abs(ref_nll - cached["ref_nll"]) > \
+                1e-6 * abs(cached["ref_nll"]):
+            _log(f"warm-ref: WARNING {label} recomputed optimum "
+                 f"{ref_nll} != cached {cached['ref_nll']}")
+        _ref_cache_put_raw(key, {"ref_nll": ref_nll,
+                                 "ref_s": round(ref_s, 2)})
+        _log(f"warm-ref: {label} solved in {ref_s:.1f}s")
+
+    # config 1
+    x, y = make_a1a_like(max(int(1024 * _SCALE), 1), "logistic", seed=42)
+    ensure("logistic_regression", x, y, 42, 0.0, 1.0, None, "c1 logistic l2")
+    # config 2
+    for task_key, task in (("linear", "linear_regression"),
+                           ("poisson", "poisson_regression")):
+        x, y = make_a1a_like(max(int(256 * _SCALE), 1), task_key, seed=52)
+        ensure(task, x, y, 52, 0.05, 0.05, None, f"c2 {task_key} en")
+        ensure(task, x, y, 52, 0.1, 0.0, None, f"c2 {task_key} l1")
+        ensure(task, x, y, 52, 0.0, 1.0, None, f"c2 {task_key} l2")
+    # config 3
+    x, y = make_a1a_like(max(int(256 * _SCALE), 1), "hinge", seed=62)
+    ensure("smoothed_hinge_loss_linear_svm", x, y, 62, 0.0, 1.0,
+           (-0.5, 0.5), "c3 hinge box")
+    # config 6
+    n = max(int(200_000 * _SCALE), 2000)
+    x, y = make_wide_sparse_logistic(n, d=250_000, nnz=64, seed=77)
+    ensure("logistic_regression", x, y, 77, 0.0, 1.0, None, "c6 wide sparse")
+
+
 def main():
     import jax
     import logging
@@ -877,12 +927,21 @@ def main():
             configs[f"config{key}"] = {"error": f"{type(e).__name__}: {e}"}
         # one cumulative line per finished config: if the harness kills the
         # suite mid-run, the LAST stdout line is still a complete result
-        # for everything finished so far
-        print(json.dumps(cumulative()), flush=True)
+        # for everything finished so far.  The same dict also lands in
+        # BENCH.json (atomic replace) because harness logs keep only the
+        # TAIL of stdout — r04's config 1-5 results were lost to truncation
+        result = cumulative()
+        tmp = "BENCH.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1)
+        os.replace(tmp, "BENCH.json")
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--game-ref":
         _game_ref_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--warm-ref-cache":
+        warm_ref_cache()
     else:
         main()
