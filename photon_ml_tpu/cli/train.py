@@ -120,10 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the persistent XLA compilation cache (on "
                         "by default so repeat invocations skip compiles; "
                         "cache dir: <repo>/.jax_cache or $PHOTON_JAX_CACHE)")
-    p.add_argument("--model-format", default="npz", choices=["npz", "avro"],
+    p.add_argument("--model-format", default="npz",
+                   choices=["npz", "avro", "reference"],
                    help="best-model output format; avro writes the "
                         "reference's BayesianLinearModelAvro / "
-                        "LatentFactorAvro interchange records")
+                        "LatentFactorAvro interchange records; reference "
+                        "writes the Scala reference's own directory layout "
+                        "(part-*.avro + id-info) that photon-ml itself "
+                        "can load")
     p.add_argument("--checkpoint-dir", default=None,
                    help="persist the model after every outer coordinate-"
                         "descent iteration and resume from the latest "

@@ -253,17 +253,34 @@ def write_glm_avro(path: str, model_id: str, task_type: str,
     write_container(path, BAYESIAN_LINEAR_MODEL_AVRO, [rec])
 
 
-def read_glm_avro(path: str, index_map: Optional[IndexMap] = None
-                  ) -> Tuple[str, Optional[str], np.ndarray,
-                             Optional[np.ndarray], IndexMap]:
-    """-> (model_id, task_type, means, variances, index_map)."""
-    recs = list(read_container(path))
-    if len(recs) != 1:
-        raise ValueError(f"{path}: expected 1 model record, got {len(recs)}")
-    rec = recs[0]
-    if index_map is None:
-        keys = [(f["name"], f["term"]) for f in rec["means"]]
-        index_map = build_index_map(keys, add_intercept=True)
+def _read_model_records(path_or_paths):
+    """BayesianLinearModelAvro records from one container file or, for the
+    reference's partitioned layout, a list of part files concatenated in
+    order."""
+    if isinstance(path_or_paths, (list, tuple)):
+        recs = []
+        for p in path_or_paths:
+            recs.extend(read_container(p))
+        return recs
+    return list(read_container(path_or_paths))
+
+
+def model_record_keys(recs) -> List[Tuple[str, str]]:
+    """All (name, term) feature keys appearing in a batch of
+    BayesianLinearModelAvro records (means + variances)."""
+    keys = []
+    for rec in recs:
+        keys.extend((f["name"], f["term"]) for f in rec["means"])
+        keys.extend((f["name"], f["term"])
+                    for f in rec.get("variances") or ())
+    return keys
+
+
+def glm_arrays_from_record(rec, index_map: IndexMap
+                           ) -> Tuple[str, Optional[str], np.ndarray,
+                                      Optional[np.ndarray]]:
+    """One BayesianLinearModelAvro record -> (model_id, task, means,
+    variances) dense in `index_map`'s column order."""
     means = np.zeros(index_map.size)
     for f in rec["means"]:
         j = index_map.index_of(f["name"], f["term"])
@@ -277,7 +294,24 @@ def read_glm_avro(path: str, index_map: Optional[IndexMap] = None
             if j >= 0:
                 variances[j] = f["value"]
     task = _TASK_BY_CLASS.get(rec.get("modelClass") or "", None)
-    return rec["modelId"], task, means, variances, index_map
+    return rec["modelId"], task, means, variances
+
+
+def read_glm_avro(path, index_map: Optional[IndexMap] = None
+                  ) -> Tuple[str, Optional[str], np.ndarray,
+                             Optional[np.ndarray], IndexMap]:
+    """-> (model_id, task_type, means, variances, index_map)."""
+    recs = _read_model_records(path)
+    if len(recs) != 1:
+        raise ValueError(f"{path}: expected 1 model record, got {len(recs)}")
+    rec = recs[0]
+    if index_map is None:
+        # means AND variances: an L1-zeroed coefficient can still carry a
+        # nonzero posterior variance entry
+        index_map = build_index_map(model_record_keys(recs),
+                                    add_intercept=True)
+    model_id, task, means, variances = glm_arrays_from_record(rec, index_map)
+    return model_id, task, means, variances, index_map
 
 
 def write_random_effect_avro(path: str, task_type: str,
@@ -319,18 +353,25 @@ def write_random_effect_avro(path: str, task_type: str,
     write_container(path, BAYESIAN_LINEAR_MODEL_AVRO, gen())
 
 
-def read_random_effect_avro(path: str, index_map: Optional[IndexMap] = None
+def read_random_effect_avro(path, index_map: Optional[IndexMap] = None
                             ) -> Tuple[List[str], np.ndarray,
                                        Optional[np.ndarray], IndexMap]:
     """-> (entity_ids, means [E, d], variances or None, index_map); models
     come back dense in ORIGINAL space (projection is a training-time
-    artifact, reference loads are original-space too)."""
-    recs = list(read_container(path))
+    artifact, reference loads are original-space too).  `path` may be a
+    list of part files (reference partitioned layout)."""
+    recs = _read_model_records(path)
     if index_map is None:
-        keys = []
-        for rec in recs:
-            keys.extend((f["name"], f["term"]) for f in rec["means"])
-        index_map = build_index_map(keys, add_intercept=True)
+        index_map = build_index_map(model_record_keys(recs),
+                                    add_intercept=True)
+    return re_arrays_from_records(recs, index_map) + (index_map,)
+
+
+def re_arrays_from_records(recs, index_map: IndexMap
+                           ) -> Tuple[List[str], np.ndarray,
+                                      Optional[np.ndarray]]:
+    """Per-entity BayesianLinearModelAvro records -> (entity_ids,
+    means [E, d], variances or None) dense in `index_map`'s order."""
     e_ids = [rec["modelId"] for rec in recs]
     d = index_map.size
     means = np.zeros((len(recs), d))
@@ -346,7 +387,7 @@ def read_random_effect_avro(path: str, index_map: Optional[IndexMap] = None
                 j = index_map.index_of(f["name"], f["term"])
                 if j >= 0:
                     variances[e, j] = f["value"]
-    return e_ids, means, variances, index_map
+    return e_ids, means, variances
 
 
 # -- scores ------------------------------------------------------------------

@@ -67,6 +67,9 @@ def save_game_model(
     on Avro save (the reference persists original-space models too)."""
     if format == "avro":
         return _save_game_model_avro(model, directory, config, index_maps)
+    if format == "reference":
+        return save_game_model_reference_layout(model, directory,
+                                                index_maps=index_maps)
     if format != "npz":
         raise ValueError(f"unknown model format {format!r}")
     os.makedirs(directory, exist_ok=True)
@@ -235,11 +238,259 @@ def _save_game_model_avro(model, directory, config, index_maps) -> None:
 
 def load_model_index_maps(directory: str) -> Optional[Dict[str, IndexMap]]:
     """The per-shard feature maps recorded at save time (needed to read
-    scoring/validation Avro data in the model's feature space)."""
+    scoring/validation Avro data in the model's feature space).  For a
+    reference-layout directory nothing was recorded, but the maps are fully
+    determined by the model records themselves (compact scan order,
+    reference: AvroUtils.makeFeatureIndexForModel), so they are rebuilt."""
     path = os.path.join(directory, "index-maps")
-    if not os.path.isdir(path):
-        return None
-    return IndexMapCollection.load(path).shards
+    if os.path.isdir(path):
+        return IndexMapCollection.load(path).shards
+    if _is_reference_layout(directory):
+        return _reference_layout_index_maps(directory)
+    return None
+
+
+# -- the Scala reference's own on-disk layout --------------------------------
+#
+# reference: ModelProcessingUtils.scala:71-135 (save) / :136-238 (load):
+#
+#   <dir>/model-metadata.json                      # {"modelType": "...", ...}
+#   <dir>/fixed-effect/<name>/id-info              # 1 line: featureShardId
+#   <dir>/fixed-effect/<name>/coefficients/part-00000.avro
+#   <dir>/random-effect/<name>/id-info             # 2 lines: REType, shardId
+#   <dir>/random-effect/<name>/coefficients/part-*.avro  (+ _SUCCESS marker)
+#
+# Coefficients are BayesianLinearModelAvro records; random-effect containers
+# hold one record per entity (modelId = entity id), split across Spark
+# partition files.
+
+_REFERENCE_TASKS = {
+    "LOGISTIC_REGRESSION": "logistic_regression",
+    "LINEAR_REGRESSION": "linear_regression",
+    "POISSON_REGRESSION": "poisson_regression",
+    "SMOOTHED_HINGE_LOSS_LINEAR_SVM": "smoothed_hinge_loss_linear_svm",
+    "NONE": None,
+}
+
+
+def _is_reference_layout(directory: str) -> bool:
+    meta_p = os.path.join(directory, "model-metadata.json")
+    if os.path.exists(meta_p):
+        try:
+            with open(meta_p) as f:
+                meta = json.load(f)
+        except ValueError:
+            return False
+        return "modelType" in meta and "coordinates" not in meta
+    # pre-metadata reference models: recognized by the id-info files
+    for kind in ("fixed-effect", "random-effect"):
+        base = os.path.join(directory, kind)
+        if os.path.isdir(base):
+            for name in os.listdir(base):
+                if os.path.exists(os.path.join(base, name, "id-info")):
+                    return True
+    return False
+
+
+def _reference_coordinate_dirs(directory: str):
+    """-> [(kind, name, shard, re_type, part_files)] sorted by name."""
+    out = []
+    for kind in ("fixed-effect", "random-effect"):
+        base = os.path.join(directory, kind)
+        if not os.path.isdir(base):
+            continue
+        for name in sorted(os.listdir(base)):
+            sub = os.path.join(base, name)
+            id_info = os.path.join(sub, "id-info")
+            coeff_dir = os.path.join(sub, "coefficients")
+            if not os.path.isdir(coeff_dir):
+                continue
+            with open(id_info) as f:
+                ids = [ln.strip() for ln in f if ln.strip()]
+            if kind == "fixed-effect":
+                (shard,), re_type = ids, None
+            else:
+                re_type, shard = ids
+            parts = sorted(
+                os.path.join(coeff_dir, fn) for fn in os.listdir(coeff_dir)
+                if not fn.startswith(("_", ".")))
+            if not parts:
+                raise ValueError(f"{coeff_dir}: no coefficient part files")
+            out.append((kind, name, shard, re_type, parts))
+    if not out:
+        raise ValueError(
+            f"no models could be loaded from reference-layout {directory!r}")
+    return out
+
+
+def _maps_from_coordinate_records(coord_recs) -> Dict[str, IndexMap]:
+    """One map per feature shard, built from the union of every
+    coordinate's record keys on that shard — coordinates sharing a shard
+    share one map, so loaded coefficient columns can never disagree."""
+    from photon_ml_tpu.data.avro_io import model_record_keys
+    keys_by_shard: Dict[str, list] = {}
+    for (_, _, shard, _, _), recs in coord_recs:
+        keys_by_shard.setdefault(shard, []).extend(model_record_keys(recs))
+    return {shard: IndexMap.from_keys(
+                [feature_key(n, t) for n, t in keys], add_intercept=True)
+            for shard, keys in keys_by_shard.items()}
+
+
+def _reference_coordinate_records(directory: str):
+    """Decode every coordinate's part files ONCE: [(dir-entry, records)]."""
+    from photon_ml_tpu.data.avro_io import _read_model_records
+    return [(entry, _read_model_records(entry[4]))
+            for entry in _reference_coordinate_dirs(directory)]
+
+
+def _reference_layout_index_maps(directory: str) -> Dict[str, IndexMap]:
+    return _maps_from_coordinate_records(
+        _reference_coordinate_records(directory))
+
+
+def _load_game_model_reference(
+    directory: str,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+) -> Tuple[GameModel, None]:
+    """Load a GAME model the Scala reference itself wrote
+    (ModelProcessingUtils.scala:136-238).  Without provided index maps the
+    feature spaces are rebuilt compactly from the records, exactly like the
+    reference's makeFeatureIndexForModel path."""
+    from photon_ml_tpu.data.avro_io import (_TASK_BY_CLASS,
+                                            glm_arrays_from_record,
+                                            re_arrays_from_records)
+    meta_task = None
+    meta_p = os.path.join(directory, "model-metadata.json")
+    if os.path.exists(meta_p):
+        with open(meta_p) as f:
+            raw = json.load(f)
+        model_type = str(raw.get("modelType", "NONE"))
+        if model_type not in _REFERENCE_TASKS:
+            raise ValueError(f"unknown reference modelType {model_type!r}")
+        meta_task = _REFERENCE_TASKS[model_type]
+    coord_recs = _reference_coordinate_records(directory)
+    if index_maps is None:
+        index_maps = _maps_from_coordinate_records(coord_recs)
+    coords = {}
+    tasks = set()
+    for (kind, name, shard, re_type, _), recs in coord_recs:
+        imap = index_maps[shard]
+        if kind == "fixed-effect":
+            if len(recs) != 1:
+                raise ValueError(
+                    f"{directory}/{kind}/{name}: expected one fixed-effect "
+                    f"record, got {len(recs)}")
+            _, task, means, variances = glm_arrays_from_record(recs[0], imap)
+            coords[name] = (task, "fe", shard, means, variances)
+        else:
+            e_ids, means, variances = re_arrays_from_records(recs, imap)
+            task = (_TASK_BY_CLASS.get(recs[0].get("modelClass") or "", None)
+                    if recs else None)  # empty Spark partitions are normal
+            coords[name] = (task, "re", shard, (e_ids, means, variances),
+                            re_type)
+        if task:
+            tasks.add(task)
+    task_type = meta_task or (tasks.pop() if len(tasks) == 1 else None)
+    if task_type is None:
+        raise ValueError(
+            f"cannot determine task type for {directory!r}: no modelType "
+            "metadata and no modelClass on the records")
+    out = {}
+    for name, info in coords.items():
+        if info[1] == "fe":
+            _, _, shard, means, variances = info
+            coeffs = Coefficients(
+                jnp.asarray(means),
+                None if variances is None else jnp.asarray(variances))
+            out[name] = FixedEffectModel(model_for_task(task_type, coeffs),
+                                         shard)
+        else:
+            _, _, shard, (e_ids, means, variances), re_type = info
+            out[name] = RandomEffectModel(
+                random_effect_type=re_type, feature_shard=shard,
+                task_type=task_type, coefficients=jnp.asarray(means),
+                entity_ids=np.asarray(e_ids, dtype=object),
+                projection=None, global_dim=means.shape[1],
+                variances=(None if variances is None
+                           else jnp.asarray(variances)))
+    return GameModel(out, task_type), None
+
+
+def save_game_model_reference_layout(
+    model: GameModel,
+    directory: str,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    num_re_partitions: int = 1,
+) -> None:
+    """Write a GAME model in the Scala reference's OWN directory layout
+    (ModelProcessingUtils.scala:71-135), so actual photon-ml can score or
+    warm-start from it.  Factored/random-projection random effects
+    materialize to original space; matrix-factorization coordinates are
+    rejected (the reference stores MF models separately, scala:450-516)."""
+    from photon_ml_tpu.data.avro_io import (write_glm_avro,
+                                            write_random_effect_avro)
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
+        json.dump({"modelType": {v: k for k, v in _REFERENCE_TASKS.items()
+                                 if v}.get(model.task_type, "NONE"),
+                   "modelName": os.path.basename(directory.rstrip("/"))},
+                  f, indent=2)
+    for name, m in model.coordinates.items():
+        if isinstance(m, MatrixFactorizationModel):
+            raise ValueError(
+                "matrix-factorization coordinates have no reference GAME "
+                "model layout (saved separately in the reference, "
+                "ModelProcessingUtils.scala:450-516)")
+        if isinstance(m, FactoredRandomEffectModel):
+            m = m.to_random_effect_model()
+        if isinstance(m, FixedEffectModel):
+            sub = os.path.join(directory, "fixed-effect", name)
+            coeff_dir = os.path.join(sub, "coefficients")
+            os.makedirs(coeff_dir, exist_ok=True)
+            with open(os.path.join(sub, "id-info"), "w") as f:
+                f.write(m.feature_shard + "\n")
+            means = np.asarray(m.glm.coefficients.means)
+            imap = (index_maps or {}).get(m.feature_shard) or \
+                _shard_index_map(None, m.feature_shard, len(means))
+            var = m.glm.coefficients.variances
+            # modelId is the literal "fixed-effect", matching the Scala
+            # writer (saveModelToHDFS passes AvroConstants.FIXED_EFFECT)
+            write_glm_avro(
+                os.path.join(coeff_dir, "part-00000.avro"), "fixed-effect",
+                model.task_type, means, imap,
+                None if var is None else np.asarray(var))
+        elif isinstance(m, RandomEffectModel):
+            if m.projection_matrix is not None:
+                m = RandomEffectModel(
+                    random_effect_type=m.random_effect_type,
+                    feature_shard=m.feature_shard, task_type=m.task_type,
+                    coefficients=m.global_coefficients(),
+                    entity_ids=m.entity_ids, projection=None,
+                    global_dim=m.global_dim)
+            sub = os.path.join(directory, "random-effect", name)
+            coeff_dir = os.path.join(sub, "coefficients")
+            os.makedirs(coeff_dir, exist_ok=True)
+            with open(os.path.join(sub, "id-info"), "w") as f:
+                f.write(m.random_effect_type + "\n" + m.feature_shard + "\n")
+            imap = (index_maps or {}).get(m.feature_shard) or \
+                _shard_index_map(None, m.feature_shard, m.global_dim)
+            E = m.num_entities
+            n_parts = max(1, min(num_re_partitions, E))
+            bounds = np.linspace(0, E, n_parts + 1).astype(int)
+            for p in range(n_parts):
+                lo, hi = int(bounds[p]), int(bounds[p + 1])
+                write_random_effect_avro(
+                    os.path.join(coeff_dir, f"part-{p:05d}.avro"),
+                    m.task_type, np.asarray(m.entity_ids)[lo:hi],
+                    np.asarray(m.coefficients)[lo:hi], imap,
+                    projection=(None if m.projection is None
+                                else m.projection[lo:hi]),
+                    variances=(None if m.variances is None
+                               else np.asarray(m.variances)[lo:hi]))
+            # Spark leaves a _SUCCESS marker; the loader must skip it
+            open(os.path.join(coeff_dir, "_SUCCESS"), "w").close()
+        else:
+            raise TypeError(f"unknown coordinate model type {type(m)}")
 
 
 def _load_game_model_avro(directory, meta):
@@ -297,9 +548,21 @@ def _load_game_model_avro(directory, meta):
 
 def load_game_model(directory: str
                     ) -> Tuple[GameModel, Optional[GameTrainingConfig]]:
-    """reference: ModelProcessingUtils.loadGameModelFromHDFS (scala:136-238)."""
-    with open(os.path.join(directory, "model-metadata.json")) as f:
+    """reference: ModelProcessingUtils.loadGameModelFromHDFS (scala:136-238).
+
+    Accepts this package's npz and Avro layouts AND a model directory the
+    Scala reference itself wrote (part-*.avro partition files + the
+    reference's own model-metadata.json, or no metadata at all for
+    pre-metadata models)."""
+    meta_p = os.path.join(directory, "model-metadata.json")
+    if not os.path.exists(meta_p):
+        if _is_reference_layout(directory):
+            return _load_game_model_reference(directory)
+        raise FileNotFoundError(meta_p)
+    with open(meta_p) as f:
         meta = json.load(f)
+    if "modelType" in meta and "coordinates" not in meta:
+        return _load_game_model_reference(directory)
     if meta.get("storage_format") == "avro":
         return _load_game_model_avro(directory, meta)
     task = meta["task_type"]
