@@ -141,11 +141,15 @@ class GameTrainingConfig:
     # -- JSON round-trip ------------------------------------------------------
     def to_dict(self) -> dict:
         def enc_opt(o: OptimizerConfig):
+            from photon_ml_tpu.optim.constraints import constraints_to_json
             return {"optimizer": o.optimizer.value, "max_iterations": o.max_iterations,
                     "tolerance": o.tolerance, "history": o.history,
                     "max_cg_iterations": o.max_cg_iterations,
                     "box_lower": list(o.box_lower) if o.box_lower else None,
                     "box_upper": list(o.box_upper) if o.box_upper else None,
+                    # the reference's JSON shape (GLMSuite constraint string)
+                    "constraints": (constraints_to_json(o.constraints)
+                                    if o.constraints else None),
                     "track_coefficients": o.track_coefficients}
 
         def enc_glm(g: GLMOptimizationConfig):
@@ -198,6 +202,8 @@ class GameTrainingConfig:
                 max_cg_iterations=o.get("max_cg_iterations", 20),
                 box_lower=tuple(o["box_lower"]) if o.get("box_lower") else None,
                 box_upper=tuple(o["box_upper"]) if o.get("box_upper") else None,
+                constraints=(tuple(o["constraints"])
+                             if o.get("constraints") else None),
                 track_coefficients=o.get("track_coefficients", False))
 
         def dec_glm(g: dict) -> GLMOptimizationConfig:

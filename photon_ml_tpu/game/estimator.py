@@ -61,9 +61,32 @@ class GameEstimator:
         self.emitter = emitter
 
     def _build_coordinates(self, dataset: GameDataset) -> Dict[str, Coordinate]:
+        import dataclasses as _dc
         coords: Dict[str, Coordinate] = {}
         for name in self.config.updating_sequence:
             cfg = self.config.coordinates[name]
+            latent = getattr(cfg, "latent_optimization", None)
+            if latent is not None and \
+                    latent.optimizer.constraints is not None:
+                raise ValueError(
+                    f"coordinate {name!r}: named feature constraints are "
+                    "not supported on the latent-projection problem")
+            if cfg.optimization.optimizer.constraints is not None:
+                # named constraints resolve through the shard's index map
+                # into positional bounds (reference scope: a fixed-effect /
+                # single-GLM feature — per-entity random-effect problems
+                # live in projected local spaces where global feature names
+                # have no stable columns)
+                if not isinstance(cfg, FixedEffectCoordinateConfig):
+                    raise ValueError(
+                        f"coordinate {name!r}: named feature constraints "
+                        "are supported on fixed-effect coordinates only "
+                        "(the reference's constraint maps are a single-GLM "
+                        "feature, GLMSuite.scala:206-280)")
+                opt = cfg.optimization.optimizer.resolved_constraints(
+                    (dataset.index_maps or {}).get(cfg.feature_shard))
+                cfg = _dc.replace(cfg, optimization=_dc.replace(
+                    cfg.optimization, optimizer=opt))
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 coords[name] = FixedEffectCoordinate(
                     name, dataset, cfg, self.config.task_type, self.mesh,

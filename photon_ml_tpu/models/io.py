@@ -305,8 +305,19 @@ def _reference_coordinate_dirs(directory: str):
             coeff_dir = os.path.join(sub, "coefficients")
             if not os.path.isdir(coeff_dir):
                 continue
+            if not os.path.exists(id_info):
+                raise ValueError(
+                    f"{sub}: reference-layout coordinate has coefficients "
+                    "but no id-info file (expected 1 line for fixed-effect: "
+                    "featureShardId; 2 for random-effect: randomEffectType, "
+                    "featureShardId)")
             with open(id_info) as f:
                 ids = [ln.strip() for ln in f if ln.strip()]
+            expected = 1 if kind == "fixed-effect" else 2
+            if len(ids) != expected:
+                raise ValueError(
+                    f"{id_info}: expected {expected} line(s) for a "
+                    f"{kind} coordinate, got {len(ids)}: {ids!r}")
             if kind == "fixed-effect":
                 (shard,), re_type = ids, None
             else:
@@ -336,11 +347,26 @@ def _maps_from_coordinate_records(coord_recs) -> Dict[str, IndexMap]:
             for shard, keys in keys_by_shard.items()}
 
 
+_REF_RECORDS_MEMO: dict = {}
+
+
 def _reference_coordinate_records(directory: str):
-    """Decode every coordinate's part files ONCE: [(dir-entry, records)]."""
+    """Decode every coordinate's part files ONCE per on-disk state:
+    [(dir-entry, records)].  Memoized on (path, file sizes+mtimes) because
+    a scoring run otherwise decodes every part file twice — once for
+    load_model_index_maps, once for load_game_model."""
     from photon_ml_tpu.data.avro_io import _read_model_records
-    return [(entry, _read_model_records(entry[4]))
-            for entry in _reference_coordinate_dirs(directory)]
+    entries = _reference_coordinate_dirs(directory)
+    stamp = tuple((p, os.path.getsize(p), os.stat(p).st_mtime_ns)
+                  for _, _, _, _, parts in entries for p in parts)
+    key = os.path.abspath(directory)
+    cached = _REF_RECORDS_MEMO.get(key)
+    if cached is not None and cached[0] == stamp:
+        return cached[1]
+    out = [(entry, _read_model_records(entry[4])) for entry in entries]
+    _REF_RECORDS_MEMO.clear()  # keep at most one directory resident
+    _REF_RECORDS_MEMO[key] = (stamp, out)
+    return out
 
 
 def _reference_layout_index_maps(directory: str) -> Dict[str, IndexMap]:
@@ -370,7 +396,13 @@ def _load_game_model_reference(
         meta_task = _REFERENCE_TASKS[model_type]
     coord_recs = _reference_coordinate_records(directory)
     if index_maps is None:
-        index_maps = _maps_from_coordinate_records(coord_recs)
+        # prefer maps saved next to the model (our own reference-layout
+        # writer records them so L1-zeroed coefficients keep their columns);
+        # a directory the Scala reference wrote has none -> rebuild compactly
+        saved = os.path.join(directory, "index-maps")
+        index_maps = (IndexMapCollection.load(saved).shards
+                      if os.path.isdir(saved)
+                      else _maps_from_coordinate_records(coord_recs))
     coords = {}
     tasks = set()
     for (kind, name, shard, re_type, _), recs in coord_recs:
@@ -430,6 +462,13 @@ def save_game_model_reference_layout(
     from photon_ml_tpu.data.avro_io import (write_glm_avro,
                                             write_random_effect_avro)
     os.makedirs(directory, exist_ok=True)
+    if index_maps:
+        # Avro records drop zero coefficients (L1 makes exact zeros
+        # common), so without the maps a reload rebuilds a shrunken,
+        # shifted feature space.  The extra index-maps/ dir is ours; the
+        # Scala reference ignores unknown directories.
+        IndexMapCollection(dict(index_maps)).save(
+            os.path.join(directory, "index-maps"))
     with open(os.path.join(directory, "model-metadata.json"), "w") as f:
         json.dump({"modelType": {v: k for k, v in _REFERENCE_TASKS.items()
                                  if v}.get(model.task_type, "NONE"),

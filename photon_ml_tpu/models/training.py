@@ -62,9 +62,14 @@ def train_glm(
     initial_model: Optional[GeneralizedLinearModel] = None,
     warm_start: bool = True,
     compute_variances: bool = False,
+    index_map=None,
 ) -> list[TrainedModel]:
     """Train one GLM per regularization weight, strongest-first with warm
-    starts.  Returns models in ORIGINAL feature space."""
+    starts.  Returns models in ORIGINAL feature space.  `index_map`
+    resolves named feature constraints (optimizer_config.constraints) into
+    positional bounds (reference: GLMSuite.createConstraintFeatureMap)."""
+    if optimizer_config.constraints is not None:
+        optimizer_config = optimizer_config.resolved_constraints(index_map)
     loss = TASK_LOSSES[task_type]
     d = num_features(x)
     dtype = labels.dtype if jnp.issubdtype(labels.dtype, jnp.floating) else jnp.float32

@@ -91,6 +91,11 @@ class OptimizerConfig:
     # array-like and solve() converts back to arrays
     box_lower: Optional[tuple] = None
     box_upper: Optional[tuple] = None
+    # NAMED-feature constraints in the reference's JSON shape
+    # ([{name, term, lowerBound, upperBound}], GLMSuite.scala:206-280);
+    # resolved against the shard's IndexMap into box_lower/box_upper at fit
+    # time (resolved_constraints()).  Exclusive with positional bounds.
+    constraints: Optional[tuple] = None
     # per-iteration coefficient snapshots in SolveResult.coefficient_history
     # (reference: ModelTracker per-iteration models); costs [max_iter+1, d]
     # device memory per solve, so off by default
@@ -101,6 +106,31 @@ class OptimizerConfig:
             v = getattr(self, name)
             if v is not None and not isinstance(v, tuple):
                 object.__setattr__(self, name, tuple(float(e) for e in jnp.asarray(v)))
+        if self.constraints is not None:
+            from photon_ml_tpu.optim.constraints import normalize_constraints
+            if self.box_lower is not None or self.box_upper is not None:
+                raise ValueError(
+                    "named constraints and positional box_lower/box_upper "
+                    "are exclusive — the constraints RESOLVE to the "
+                    "positional bounds")
+            object.__setattr__(self, "constraints",
+                               normalize_constraints(self.constraints))
+
+    def resolved_constraints(self, index_map) -> "OptimizerConfig":
+        """Named constraints -> positional bounds via the feature shard's
+        IndexMap (reference: GLMSuite.createConstraintFeatureMap)."""
+        if self.constraints is None:
+            return self
+        from photon_ml_tpu.optim.constraints import resolve_constraints
+        if index_map is None:
+            raise ValueError(
+                "named feature constraints require the dataset to carry an "
+                "index map for the coordinate's feature shard (train from "
+                "Avro/LIBSVM-with-maps or an npz GameDataset saved with "
+                "index maps)")
+        lower, upper = resolve_constraints(self.constraints, index_map)
+        return dataclasses.replace(self, constraints=None,
+                                   box_lower=lower, box_upper=upper)
 
     def resolved(self) -> "OptimizerConfig":
         # explicit 0 / 0.0 are legitimate (e.g. tolerance=0 disables the
@@ -129,6 +159,10 @@ def solve(
     per-entity solves) at the call site.
     """
     cfg = config.resolved()
+    if cfg.constraints is not None:
+        raise ValueError(
+            "named feature constraints are unresolved — call "
+            "config.resolved_constraints(index_map) before solve()")
     l1_w, l2_w = reg.split(reg_weight)
     obj = objective.with_l2(l2_w)
 

@@ -1,0 +1,258 @@
+"""Named-feature box-constraint maps.
+
+reference: GLMSuite.createConstraintFeatureMap (photon-client/.../io/
+deprecated/GLMSuite.scala:206-280) + ConstraintMapKeys.scala — JSON
+{name, term, lowerBound, upperBound} entries resolved through the index
+map into positional per-coefficient bounds.
+"""
+import json
+import math
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import build_game_dataset, build_index_map
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.constraints import (constraints_to_json,
+                                             normalize_constraints,
+                                             resolve_constraints)
+
+INF = math.inf
+
+
+def _imap():
+    return build_index_map([("age", ""), ("age", "young"), ("height", ""),
+                            ("weight", "kg")])  # + intercept, sorted
+
+
+# -- normalize ----------------------------------------------------------------
+
+def test_normalize_defaults_missing_bounds_to_inf():
+    (entry,) = normalize_constraints([{"name": "age", "term": "",
+                                       "lowerBound": -1.0}])
+    assert entry == ("age", "", -1.0, INF)
+    (entry,) = normalize_constraints([{"name": "age", "term": "",
+                                       "upperBound": 2.5}])
+    assert entry == ("age", "", -INF, 2.5)
+
+
+def test_normalize_rejects_bad_entries():
+    with pytest.raises(ValueError, match="unconstrained entry is invalid"):
+        normalize_constraints([{"name": "a", "term": ""}])
+    with pytest.raises(ValueError, match="must be < upper"):
+        normalize_constraints([{"name": "a", "term": "", "lowerBound": 2.0,
+                                "upperBound": 1.0}])
+    with pytest.raises(ValueError, match="wildcard in feature name alone"):
+        normalize_constraints([{"name": "*", "term": "t",
+                                "lowerBound": 0.0}])
+    with pytest.raises(ValueError, match="must specify 'name' and 'term'"):
+        normalize_constraints([{"name": "a", "lowerBound": 0.0}])
+    with pytest.raises(ValueError, match="unknown constraint keys"):
+        normalize_constraints([{"name": "a", "term": "", "lower": 0.0}])
+    with pytest.raises(ValueError, match="only entry"):
+        normalize_constraints([
+            {"name": "*", "term": "*", "lowerBound": 0.0},
+            {"name": "a", "term": "", "upperBound": 1.0}])
+
+
+# -- resolve ------------------------------------------------------------------
+
+def test_resolve_specific_and_unseen():
+    imap = _imap()
+    lower, upper = resolve_constraints(
+        normalize_constraints([
+            {"name": "age", "term": "young", "lowerBound": -1, "upperBound": 1},
+            {"name": "ghost", "term": "", "lowerBound": 0.0}]),  # unseen: skipped
+        imap)
+    j = imap.index_of("age", "young")
+    assert (lower[j], upper[j]) == (-1.0, 1.0)
+    for k in range(imap.size):
+        if k != j:
+            assert (lower[k], upper[k]) == (-INF, INF)
+
+
+def test_resolve_wildcard_all_excludes_intercept():
+    imap = _imap()
+    lower, upper = resolve_constraints(
+        normalize_constraints([{"name": "*", "term": "*",
+                                "lowerBound": -0.5, "upperBound": 0.5}]),
+        imap)
+    for k in range(imap.size):
+        if k == imap.intercept_index:
+            assert (lower[k], upper[k]) == (-INF, INF)
+        else:
+            assert (lower[k], upper[k]) == (-0.5, 0.5)
+
+
+def test_resolve_term_wildcard_and_conflict():
+    imap = _imap()
+    lower, upper = resolve_constraints(
+        normalize_constraints([{"name": "age", "term": "*",
+                                "upperBound": 3.0}]), imap)
+    for name, term in [("age", ""), ("age", "young")]:
+        j = imap.index_of(name, term)
+        assert (lower[j], upper[j]) == (-INF, 3.0)
+    assert upper[imap.index_of("height")] == INF
+    with pytest.raises(ValueError, match="conflicting bounds"):
+        resolve_constraints(
+            normalize_constraints([
+                {"name": "age", "term": "*", "upperBound": 3.0},
+                {"name": "age", "term": "young", "lowerBound": 0.0}]),
+            imap)
+
+
+def test_constraints_json_roundtrip():
+    entries = normalize_constraints([
+        {"name": "age", "term": "young", "lowerBound": -1, "upperBound": 1},
+        {"name": "height", "term": "", "upperBound": 2}])
+    js = constraints_to_json(entries)
+    assert js == [{"name": "age", "term": "young",
+                   "lowerBound": -1.0, "upperBound": 1.0},
+                  {"name": "height", "term": "", "upperBound": 2.0}]
+    assert normalize_constraints(js) == entries
+
+
+# -- OptimizerConfig integration ---------------------------------------------
+
+def test_optimizer_config_normalizes_and_resolves():
+    cfg = OptimizerConfig(constraints=[{"name": "age", "term": "",
+                                        "lowerBound": 0.0}])
+    assert cfg.constraints == (("age", "", 0.0, INF),)
+    imap = _imap()
+    r = cfg.resolved_constraints(imap)
+    assert r.constraints is None
+    assert r.box_lower[imap.index_of("age")] == 0.0
+    assert r.box_upper[imap.index_of("age")] == INF
+    with pytest.raises(ValueError, match="exclusive"):
+        OptimizerConfig(constraints=[{"name": "a", "term": "",
+                                      "lowerBound": 0.0}],
+                        box_lower=(0.0,), box_upper=(1.0,))
+    with pytest.raises(ValueError, match="index map"):
+        cfg.resolved_constraints(None)
+
+
+def test_solve_rejects_unresolved_constraints():
+    import jax.numpy as jnp
+    from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+    from photon_ml_tpu.optim import solve
+    obj = GLMObjective(TASK_LOSSES["linear_regression"],
+                       jnp.ones((4, 2)), jnp.zeros(4))
+    with pytest.raises(ValueError, match="unresolved"):
+        solve(obj, jnp.zeros(2),
+              OptimizerConfig(constraints=[{"name": "a", "term": "",
+                                            "lowerBound": 0.0}]))
+
+
+def test_train_glm_named_equals_positional(rng):
+    """train_glm with named constraints == the positional-bounds path."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.models.training import train_glm
+    imap = _imap()
+    d = imap.size
+    x = rng.normal(size=(300, d))
+    w = rng.normal(size=d)
+    y = x @ w + 0.05 * rng.normal(size=300)
+    con = [{"name": "age", "term": "", "lowerBound": -0.1, "upperBound": 0.1},
+           {"name": "height", "term": "", "upperBound": 0.0}]
+    lower = [-INF] * d
+    upper = [INF] * d
+    lower[imap.index_of("age")], upper[imap.index_of("age")] = -0.1, 0.1
+    upper[imap.index_of("height")] = 0.0
+    named = train_glm(jnp.asarray(x), jnp.asarray(y), "linear_regression",
+                      optimizer_config=OptimizerConfig(constraints=con),
+                      index_map=imap)
+    positional = train_glm(jnp.asarray(x), jnp.asarray(y), "linear_regression",
+                           optimizer_config=OptimizerConfig(
+                               box_lower=tuple(lower), box_upper=tuple(upper)))
+    cn = np.asarray(named[0].model.coefficients.means)
+    cp = np.asarray(positional[0].model.coefficients.means)
+    np.testing.assert_allclose(cn, cp, rtol=1e-6)
+    assert -0.1 - 1e-6 <= cn[imap.index_of("age")] <= 0.1 + 1e-6
+    assert cn[imap.index_of("height")] <= 1e-6
+
+
+def test_game_estimator_resolves_constraints(rng):
+    """Named constraints on the fixed-effect coordinate flow through
+    GameEstimator.fit; random-effect coordinates reject them."""
+    from photon_ml_tpu.game import (FixedEffectCoordinateConfig, GameEstimator,
+                                    GameTrainingConfig, GLMOptimizationConfig,
+                                    RandomEffectCoordinateConfig)
+    from photon_ml_tpu.optim import RegularizationContext, RegularizationType
+    L2 = RegularizationContext(RegularizationType.L2)
+    n = 400
+    imap = build_index_map([(f"g{i}", "") for i in range(5)])
+    x = rng.normal(size=(n, imap.size))
+    y = x @ rng.normal(size=imap.size) + 0.1 * rng.normal(size=n)
+    users = np.asarray([f"u{i % 5}" for i in range(n)])
+    xu = rng.normal(size=(n, 3))
+    ds = build_game_dataset(y, {"global": x, "per_user": xu},
+                            entity_ids={"userId": users},
+                            index_maps={"global": imap})
+    con = [{"name": "g1", "term": "", "lowerBound": -0.05,
+            "upperBound": 0.05}]
+    cfg = GameTrainingConfig(
+        task_type="linear_regression",
+        coordinates={"fixed": FixedEffectCoordinateConfig(
+            "global", GLMOptimizationConfig(
+                optimizer=OptimizerConfig(constraints=con),
+                regularization=L2, regularization_weight=0.01))},
+        updating_sequence=["fixed"], num_outer_iterations=1)
+    res = GameEstimator(cfg).fit(ds)
+    c = np.asarray(
+        res.model.coordinates["fixed"].glm.coefficients.means)
+    assert -0.05 - 1e-6 <= c[imap.index_of("g1")] <= 0.05 + 1e-6
+    # config JSON round-trips the constraint entries
+    cfg2 = GameTrainingConfig.from_json(cfg.to_json())
+    assert cfg2 == cfg
+
+    bad = GameTrainingConfig(
+        task_type="linear_regression",
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global"),
+            "perUser": RandomEffectCoordinateConfig(
+                "userId", "per_user", GLMOptimizationConfig(
+                    optimizer=OptimizerConfig(constraints=con)))},
+        updating_sequence=["fixed", "perUser"], num_outer_iterations=1)
+    with pytest.raises(ValueError, match="fixed-effect coordinates only"):
+        GameEstimator(bad).fit(ds)
+
+
+def test_cli_constraints_e2e(tmp_path, rng):
+    """Constraints in the config JSON flow through the train CLI and bind
+    the saved model's coefficients."""
+    from tests.test_io_cli import _run_cli
+    from photon_ml_tpu.data.avro_game import write_game_examples
+    from photon_ml_tpu.models.io import load_game_model, load_model_index_maps
+
+    n = 300
+    imap = build_index_map([(f"g{i}", "") for i in range(5)])
+    x = (rng.uniform(size=(n, imap.size)) < 0.6).astype(float)
+    y = x @ rng.normal(size=imap.size) + 0.1 * rng.normal(size=n)
+    data_p = str(tmp_path / "train.avro")
+    write_game_examples(data_p, y, bags={"features": (x, imap)})
+    cfg = {
+        "task_type": "linear_regression",
+        "coordinates": {"fixed": {
+            "kind": "fixed_effect", "feature_shard": "global",
+            "optimization": {
+                "optimizer": {
+                    "optimizer": "lbfgs",
+                    "constraints": [{"name": "g2", "term": "",
+                                     "lowerBound": -0.02,
+                                     "upperBound": 0.02}]},
+                "regularization": {"type": "l2"},
+                "regularization_weight": 0.01}}},
+        "updating_sequence": ["fixed"], "num_outer_iterations": 1}
+    cfg_p = str(tmp_path / "game.json")
+    with open(cfg_p, "w") as f:
+        json.dump(cfg, f)
+    out_dir = str(tmp_path / "out")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", data_p, "--task", "linear_regression",
+                  "--config", cfg_p, "--output-dir", out_dir])
+    assert r.returncode == 0, r.stderr[-2000:]
+    model, _ = load_game_model(out_dir + "/best")
+    maps = load_model_index_maps(out_dir + "/best")
+    gmap = maps["global"]
+    c = np.asarray(model.coordinates["fixed"].glm.coefficients.means)
+    assert -0.02 - 1e-6 <= c[gmap.index_of("g2")] <= 0.02 + 1e-6

@@ -167,6 +167,32 @@ def test_reference_layout_roundtrip_partitioned(tmp_path, rng):
                                atol=1e-5)
 
 
+def test_reference_layout_keeps_feature_space_with_zero_coefficients(
+        tmp_path, rng):
+    """Avro records drop zero coefficients; with index_maps provided at
+    save, the reload must keep the FULL feature space (columns stable), not
+    a compacted one."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+    from photon_ml_tpu.models.glm import model_for_task
+    from photon_ml_tpu.models.coefficients import Coefficients
+    imap = build_index_map([(f"g{i}", "") for i in range(4)])
+    means = np.asarray([0.7, 0.0, -1.2, 0.0, 0.5])  # g1, g3 exactly zero
+    model = GameModel(
+        {"fixed": FixedEffectModel(
+            model_for_task("linear_regression",
+                           Coefficients(jnp.asarray(means))), "global")},
+        "linear_regression")
+    root = str(tmp_path / "refModel")
+    save_game_model_reference_layout(model, root,
+                                     index_maps={"global": imap})
+    maps = load_model_index_maps(root)
+    assert maps["global"].size == imap.size
+    loaded, _ = load_game_model(root)
+    got = np.asarray(loaded.coordinates["fixed"].glm.coefficients.means)
+    np.testing.assert_allclose(got, means)
+
+
 def test_our_avro_model_also_readable_as_before(tmp_path, rng):
     """Detection must not break this package's own avro format."""
     ds, _ = _dataset(rng, n=300)
