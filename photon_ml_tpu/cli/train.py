@@ -41,6 +41,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "[\"userFeatures\"]}' (reference: readMerged "
                         "featureColumnMap); default merges the 'features' "
                         "bag into one 'global' shard")
+    p.add_argument("--index-map-dir", default=None,
+                   help="directory of prebuilt per-shard index maps "
+                        "(python -m photon_ml_tpu.cli.index); pins this "
+                        "job's Avro ingest to that frozen feature space so "
+                        "separate jobs share identical feature dimensions "
+                        "and key->column assignment (reference: "
+                        "FeatureIndexingJob + PalDBIndexMapLoader)")
     p.add_argument("--id-columns", default=None,
                    help="Avro inputs: comma-separated random-effect id tags "
                         "to extract (top-level field or metadataMap key)")
@@ -212,7 +219,7 @@ def parse_feature_shard_map(arg):
 
 
 def _load_dataset(path: str, task: str, args=None, train_dataset=None,
-                  date_range=None, days_ago=None):
+                  date_range=None, days_ago=None, pinned_maps=None):
     """`train_dataset` pins a validation read to the TRAINING feature/entity
     spaces: separately-scanned Avro validation data would otherwise build
     its own sorted vocabularies and silently misalign columns with the
@@ -224,6 +231,10 @@ def _load_dataset(path: str, task: str, args=None, train_dataset=None,
     from photon_ml_tpu.data import build_game_dataset, read_libsvm
     from photon_ml_tpu.data.game_data import load_game_dataset
     if path.endswith(".libsvm") or path.endswith(".txt"):
+        if pinned_maps is not None:
+            raise SystemExit(
+                "--index-map-dir requires Avro training input: LIBSVM "
+                "features are positional, not (name, term)-keyed")
         x, y = read_libsvm(path)
         return build_game_dataset(y, {"global": x})
     if date_range or days_ago:
@@ -264,11 +275,16 @@ def _load_dataset(path: str, task: str, args=None, train_dataset=None,
             id_columns=[c for c in id_cols.split(",") if c],
             columns=parse_input_columns(
                 getattr(args, "input_columns", None) if args else None),
-            index_maps=(train_dataset.index_maps or None
+            index_maps=(pinned_maps if pinned_maps is not None
+                        else train_dataset.index_maps or None
                         if train_dataset is not None else None),
             entity_vocabs=(train_dataset.entity_vocabs or None
                            if train_dataset is not None else None))
         return result.dataset
+    if pinned_maps is not None:
+        raise SystemExit(
+            "--index-map-dir requires Avro training input; an npz "
+            "GameDataset already carries its feature spaces")
     return load_game_dataset(path)
 
 
@@ -336,9 +352,20 @@ def _run(args, log) -> int:
                                      RegularizationContext, RegularizationType)
 
     t0 = time.time()
+    pinned_maps = None
+    if args.index_map_dir:
+        # frozen shared feature space (reference: FeatureIndexingJob +
+        # PalDBIndexMapLoader): jobs trained against the same prebuilt maps
+        # are guaranteed identical feature dimensions and key->column
+        # assignment, whatever data slice each one saw
+        from photon_ml_tpu.data.index_map import IndexMapCollection
+        pinned_maps = IndexMapCollection.load(args.index_map_dir).shards
+        log.info("pinned feature spaces from %s: %s", args.index_map_dir,
+                 {s: m.size for s, m in pinned_maps.items()})
     train = _load_dataset(args.train_data, args.task, args,
                           date_range=args.input_date_range,
-                          days_ago=args.input_days_ago)
+                          days_ago=args.input_days_ago,
+                          pinned_maps=pinned_maps)
     val = (_load_dataset(args.validation_data, args.task, args,
                          train_dataset=train,
                          date_range=args.validation_date_range,

@@ -110,7 +110,19 @@ class GameEstimator:
         same first evaluator."""
         import hashlib
         import json
-        d = self.config.to_dict()
+
+        def strip_nones(v):
+            # drop None-valued keys so ADDING an optional config field (new
+            # release) does not shift every existing fingerprint and
+            # silently invalidate old checkpoints
+            if isinstance(v, dict):
+                return {k: strip_nones(x) for k, x in v.items()
+                        if x is not None}
+            if isinstance(v, list):
+                return [strip_nones(x) for x in v]
+            return v
+
+        d = strip_nones(self.config.to_dict())
         d.pop("num_outer_iterations", None)
         d["__evaluator_specs__"] = list(evaluator_specs or [])
         return hashlib.sha256(

@@ -347,31 +347,43 @@ def _maps_from_coordinate_records(coord_recs) -> Dict[str, IndexMap]:
             for shard, keys in keys_by_shard.items()}
 
 
-_REF_RECORDS_MEMO: dict = {}
+_REF_MAPS_MEMO: dict = {}
 
 
-def _reference_coordinate_records(directory: str):
-    """Decode every coordinate's part files ONCE per on-disk state:
-    [(dir-entry, records)].  Memoized on (path, file sizes+mtimes) because
-    a scoring run otherwise decodes every part file twice — once for
-    load_model_index_maps, once for load_game_model."""
+def _reference_dir_stamp(directory: str, entries) -> tuple:
+    """On-disk identity of a reference model dir: every part file AND every
+    id-info file (sizes + mtimes)."""
+    files = [p for _, _, _, _, parts in entries for p in parts]
+    for kind, name, _, _, _ in entries:
+        files.append(os.path.join(directory, kind, name, "id-info"))
+    return tuple((p, os.path.getsize(p), os.stat(p).st_mtime_ns)
+                 for p in files)
+
+
+def _memoized_reference_maps(directory, entries, coord_recs=None):
+    """The rebuilt per-shard maps, memoized per on-disk state so a scoring
+    run (load_game_model + load_model_index_maps) decodes every part file
+    once, not twice.  Only the LIGHT maps are retained — record lists are
+    never cached, so a loaded multi-million-entity model is not held
+    resident twice."""
     from photon_ml_tpu.data.avro_io import _read_model_records
-    entries = _reference_coordinate_dirs(directory)
-    stamp = tuple((p, os.path.getsize(p), os.stat(p).st_mtime_ns)
-                  for _, _, _, _, parts in entries for p in parts)
+    stamp = _reference_dir_stamp(directory, entries)
     key = os.path.abspath(directory)
-    cached = _REF_RECORDS_MEMO.get(key)
+    cached = _REF_MAPS_MEMO.get(key)
     if cached is not None and cached[0] == stamp:
         return cached[1]
-    out = [(entry, _read_model_records(entry[4])) for entry in entries]
-    _REF_RECORDS_MEMO.clear()  # keep at most one directory resident
-    _REF_RECORDS_MEMO[key] = (stamp, out)
-    return out
+    if coord_recs is None:
+        coord_recs = [(entry, _read_model_records(entry[4]))
+                      for entry in entries]
+    maps = _maps_from_coordinate_records(coord_recs)
+    _REF_MAPS_MEMO.clear()  # keep at most one directory resident
+    _REF_MAPS_MEMO[key] = (stamp, maps)
+    return maps
 
 
 def _reference_layout_index_maps(directory: str) -> Dict[str, IndexMap]:
-    return _maps_from_coordinate_records(
-        _reference_coordinate_records(directory))
+    return _memoized_reference_maps(directory,
+                                    _reference_coordinate_dirs(directory))
 
 
 def _load_game_model_reference(
@@ -394,7 +406,10 @@ def _load_game_model_reference(
         if model_type not in _REFERENCE_TASKS:
             raise ValueError(f"unknown reference modelType {model_type!r}")
         meta_task = _REFERENCE_TASKS[model_type]
-    coord_recs = _reference_coordinate_records(directory)
+    from photon_ml_tpu.data.avro_io import _read_model_records
+    entries = _reference_coordinate_dirs(directory)
+    coord_recs = [(entry, _read_model_records(entry[4]))
+                  for entry in entries]
     if index_maps is None:
         # prefer maps saved next to the model (our own reference-layout
         # writer records them so L1-zeroed coefficients keep their columns);
@@ -402,7 +417,8 @@ def _load_game_model_reference(
         saved = os.path.join(directory, "index-maps")
         index_maps = (IndexMapCollection.load(saved).shards
                       if os.path.isdir(saved)
-                      else _maps_from_coordinate_records(coord_recs))
+                      else _memoized_reference_maps(directory, entries,
+                                                    coord_recs))
     coords = {}
     tasks = set()
     for (kind, name, shard, re_type, _), recs in coord_recs:
