@@ -3,7 +3,8 @@
 reference: the legacy Driver's DIAGNOSED stage (photon-client/.../
 Driver.scala:468-607), which assembles metrics, Hosmer-Lemeshow, bootstrap,
 feature importance, and fitting diagnostics into an HTML report.  Here the
-same analyses emit report.json + report.md.
+same analyses emit report.json + report.md + a self-contained report.html
+(inline CSS/SVG, no plotting stack).
 
   python -m photon_ml_tpu.cli.diagnose --model-dir out/best --data d.npz \
       --output-dir diag/ [--coordinate fixed] [--bootstrap-samples 10]
@@ -55,7 +56,7 @@ def main(argv=None) -> int:
     from photon_ml_tpu.diagnostics import (
         DiagnosticReport, bootstrap_training, evaluate_scores,
         feature_importance, fitting_diagnostic, hosmer_lemeshow,
-        kendall_tau_analysis, render_markdown,
+        kendall_tau_analysis, render_html, render_markdown,
     )
     from photon_ml_tpu.game.config import FixedEffectCoordinateConfig
     from photon_ml_tpu.models.game import FixedEffectModel
@@ -135,6 +136,8 @@ def main(argv=None) -> int:
         f.write(report.to_json())
     with open(os.path.join(args.output_dir, "report.md"), "w") as f:
         f.write(render_markdown(report))
+    with open(os.path.join(args.output_dir, "report.html"), "w") as f:
+        f.write(render_html(report))
     print(json.dumps({"metrics": metrics,
                       "coordinate": fe_name,
                       "output": args.output_dir}))

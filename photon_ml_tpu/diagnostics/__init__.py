@@ -6,4 +6,5 @@ from photon_ml_tpu.diagnostics.hl import HosmerLemeshowReport, hosmer_lemeshow  
 from photon_ml_tpu.diagnostics.independence import KendallTauReport, kendall_tau_analysis  # noqa: F401
 from photon_ml_tpu.diagnostics.importance import FeatureImportanceReport, feature_importance  # noqa: F401
 from photon_ml_tpu.diagnostics.fitting import FittingReport, fitting_diagnostic  # noqa: F401
-from photon_ml_tpu.diagnostics.report import DiagnosticReport, render_markdown  # noqa: F401
+from photon_ml_tpu.diagnostics.report import (DiagnosticReport,  # noqa: F401
+                                              render_html, render_markdown)

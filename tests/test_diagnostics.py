@@ -240,6 +240,13 @@ def test_diagnose_cli_end_to_end(rng, tmp_path):
     assert "fitting" in report
     md = (tmp_path / "diag" / "report.md").read_text()
     assert "Hosmer-Lemeshow" in md and "Learning curves" in md
+    # self-contained HTML: inline CSS + inline SVG charts, no external
+    # resources (VERDICT r4 coverage item #95)
+    html = (tmp_path / "diag" / "report.html").read_text()
+    assert "<style>" in html and html.count("<svg") >= 2
+    assert "Hosmer-Lemeshow" in html and "Learning curves" in html
+    assert "http://" not in html and "https://" not in html
+    assert "<script" not in html
 
 
 def test_diagnose_cli_avro_input(rng, tmp_path):
