@@ -20,9 +20,11 @@ blocks; the training loop touches only dense arrays after this point.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import weakref
 from typing import Dict, List, Optional, Tuple  # noqa: F401
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -86,6 +88,14 @@ class FixedEffectDataset:
             feature_shard=config.feature_shard)
 
 
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def _gather_flat_offsets(flat, safe_ids, mask, dtype):
+    """Canonical-order offsets -> [Eb, Sb] block layout, one fused program
+    (addScoresToOffsets runs per bucket per coordinate update; op-by-op it
+    costs several executable uploads per shape on a tunneled device)."""
+    return (flat[safe_ids] * mask).astype(dtype)
+
+
 @dataclasses.dataclass
 class EntityBucket:
     """One size-class of entities: lanes [lane_start, lane_start + Eb) of the
@@ -99,16 +109,25 @@ class EntityBucket:
     lane_start: int
     blocks: EntityBlocks            # [Eb, Sb, d]
     row_ids: np.ndarray             # [Eb, Sb] canonical row ids, -1 = pad
+    _safe_ids_dev: object = dataclasses.field(default=None, repr=False,
+                                              compare=False)
 
     @property
     def num_entities(self) -> int:
         return self.blocks.num_entities
 
+    def safe_ids_dev(self) -> jnp.ndarray:
+        """Device copy of clamped row ids, transferred once per bucket."""
+        if self._safe_ids_dev is None:
+            object.__setattr__(self, "_safe_ids_dev", jnp.asarray(
+                np.maximum(self.row_ids, 0).astype(np.int32)))
+        return self._safe_ids_dev
+
     def with_offsets_from_flat(self, flat_offsets) -> EntityBlocks:
-        flat = jnp.asarray(flat_offsets)
-        safe = jnp.maximum(jnp.asarray(self.row_ids), 0)
-        off = flat[safe] * jnp.asarray(self.blocks.mask)
-        return self.blocks.with_offsets(off.astype(self.blocks.x.dtype))
+        off = _gather_flat_offsets(jnp.asarray(flat_offsets),
+                                   self.safe_ids_dev(), self.blocks.mask,
+                                   jnp.dtype(self.blocks.x.dtype).name)
+        return self.blocks.with_offsets(off)
 
 
 @dataclasses.dataclass
@@ -207,15 +226,21 @@ class RandomEffectDataset:
                 offsets=cat(lambda b: b.offsets, 0.0))
         return self._global_blocks
 
+    _safe_ids_dev: object = dataclasses.field(default=None, repr=False,
+                                              compare=False)
+
     def with_offsets_from_flat(self, flat_offsets) -> EntityBlocks:
         """addScoresToOffsets (reference: RandomEffectDataSet.scala:68-88):
         gather the canonical-order offset vector into block layout
         (single-S view; bucketed consumers use EntityBucket's)."""
         blocks = self.blocks
-        flat = jnp.asarray(flat_offsets)
-        safe = jnp.maximum(jnp.asarray(self.active_row_ids), 0)
-        off = flat[safe] * jnp.asarray(blocks.mask)
-        return blocks.with_offsets(off.astype(blocks.x.dtype))
+        if self._safe_ids_dev is None:
+            self._safe_ids_dev = jnp.asarray(
+                np.maximum(self.active_row_ids, 0).astype(np.int32))
+        off = _gather_flat_offsets(jnp.asarray(flat_offsets),
+                                   self._safe_ids_dev, blocks.mask,
+                                   jnp.dtype(blocks.x.dtype).name)
+        return blocks.with_offsets(off)
 
     def scatter_to_global(self, local_coefficients) -> jnp.ndarray:
         """[E, d_local] local-space coefficients -> [E, d_global]
@@ -268,6 +293,14 @@ def build_random_effect_dataset(
 def _ceil_pow2(v: np.ndarray) -> np.ndarray:
     """Elementwise smallest power of two >= v (v >= 1)."""
     return 1 << np.ceil(np.log2(np.maximum(v, 1))).astype(np.int64)
+
+
+def _is_np_dense(x) -> bool:
+    try:
+        import scipy.sparse as sp
+        return not sp.issparse(x)
+    except ImportError:
+        return True
 
 
 def _build_random_effect_dataset(
@@ -408,6 +441,17 @@ def _build_random_effect_dataset(
                          "'index_map', 'identity', or 'random_projection:<k>')")
 
     # --- assemble buckets -------------------------------------------------
+    # blocks assemble on the host and transfer asynchronously (jnp.asarray
+    # starts the DMA immediately).  A device-side gather from the flat
+    # shard was tried and measured NET NEGATIVE over the tunneled device:
+    # it removed ~half the bytes but added 8 gather programs whose
+    # per-process executable uploads cost more than the transfer saved
+    # (program count, not bytes, is the scarce resource there).
+    if not _is_np_dense(dataset.feature_shards[config.feature_shard]):
+        raise TypeError(
+            f"random-effect shard {config.feature_shard!r} must be a dense "
+            "array (sparse per-entity shards would gather ragged columns); "
+            "project or densify it at ingest")
     buckets = []
     num_active = len(row_ids_l)
     in_bucket_of_lane = np.searchsorted(bucket_bounds, lane_l, side="right") - 1

@@ -70,6 +70,26 @@ class GameDataset:
             if len(idx) != n:
                 raise ValueError(f"entity index {re_type!r} has {len(idx)} rows, expected {n}")
 
+    # device copies of feature shards, transferred ONCE per dataset and
+    # shared by every consumer (coordinate scoring, validation rescoring,
+    # per-entity block gathers): over a slow host->device link a duplicate
+    # shard transfer costs seconds, and validation rescoring runs every
+    # coordinate update
+    _device_shards: Dict[str, object] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
+    # scoring-side memos (entity-lane maps etc.), keyed by consumer
+    _scoring_cache: Dict[object, object] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
+
+    def device_shard(self, shard: str):
+        """Device FeatureMatrix view of a shard (dense -> jnp array, scipy
+        sparse -> PaddedSparse), built once and shared."""
+        if shard not in self._device_shards:
+            from photon_ml_tpu.ops.features import as_feature_matrix
+            self._device_shards[shard] = as_feature_matrix(
+                self.feature_shards[shard])
+        return self._device_shards[shard]
+
     @property
     def num_rows(self) -> int:
         return len(self.response)

@@ -303,15 +303,18 @@ def run_coordinate_descent(
                         else jnp.asarray(dataset.offsets))
         _sync(labels, weights, base_offsets)
 
-    # per-coordinate regularization terms, recomputed ONLY for the updated
-    # coordinate (each term is a device readback; the reference recomputes
-    # every term per update via join+reduce, CoordinateDescent.scala:243-254)
-    reg_terms: Dict[str, float] = {}
+    # per-coordinate regularization terms as DEVICE scalars, recomputed
+    # ONLY for the updated coordinate and folded into the data term so each
+    # objective evaluation costs ONE device readback (the reference
+    # recomputes every term per update via join+reduce,
+    # CoordinateDescent.scala:243-254; a float() per term would pay one
+    # tunnel round-trip each)
+    reg_terms: Dict[str, object] = {}
 
     def training_objective(total_scores) -> float:
-        return (float(_data_term(total_scores, base_offsets, labels,
-                                 weights, loss=loss))
-                + sum(reg_terms.values()))
+        return float(_data_term(total_scores, base_offsets, labels,
+                                weights, loss=loss)
+                     + sum(reg_terms.values()))
 
     # init (reference: CoordinateDescent.run line 57-96); a resume record
     # overrides the initial models and restores histories + best tracking
@@ -330,14 +333,30 @@ def run_coordinate_descent(
                            "checkpointed models")
         initial_models = resume.initial_models
     with spans.span("init/score"):
-        models = {name: (initial_models or {}).get(name) or
-                  coordinates[name].initial_model()
-                  for name in updating_sequence}
-        scores = {name: coordinates[name].score(models[name])
-                  for name in updating_sequence}
-        total = sum(scores.values(), jnp.zeros(dataset.num_rows))
-        reg_terms.update({name: coordinates[name].regularization_term(
-            models[name]) for name in updating_sequence})
+        zeros = jnp.zeros(dataset.num_rows)
+        models, scores = {}, {}
+        for name in updating_sequence:
+            provided = (initial_models or {}).get(name)
+            if provided is None:
+                # default initial models are zero-coefficient by
+                # construction (reference: Coordinate.initializeModel), so
+                # their scores are exactly zero — no device work.  The
+                # regularization term is zero too EXCEPT for factored
+                # coordinates, whose initial Gaussian projection carries a
+                # latent-problem penalty
+                models[name] = coordinates[name].initial_model()
+                scores[name] = zeros
+                cfg = getattr(coordinates[name], "config", None)
+                reg_terms[name] = (
+                    coordinates[name].regularization_term(models[name])
+                    if getattr(cfg, "latent_optimization", None) is not None
+                    else 0.0)
+            else:
+                models[name] = provided
+                scores[name] = coordinates[name].score(provided)
+                reg_terms[name] = coordinates[name].regularization_term(
+                    provided)
+        total = sum(scores.values(), zeros)
         _sync(total)
 
     objective_history: List[float] = list(
@@ -359,8 +378,11 @@ def run_coordinate_descent(
     val_scores_by_coord = {}
     if do_validation:
         with spans.span("init/validation_score"):
+            val_zeros = jnp.zeros(validation_dataset.num_rows)
             val_scores_by_coord = {
-                name: models[name].score_dataset(validation_dataset)
+                name: (val_zeros
+                       if (initial_models or {}).get(name) is None
+                       else models[name].score_dataset(validation_dataset))
                 for name in updating_sequence}
             _sync(*val_scores_by_coord.values())
 

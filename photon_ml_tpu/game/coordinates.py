@@ -54,6 +54,14 @@ from photon_ml_tpu.parallel.random_effect import (
 )
 
 
+@jax.jit
+def _penalty(c, l1, l2):
+    """0.5*l2*||c||^2 + l1*||c||_1 as ONE program (reg terms re-evaluate
+    every coordinate update; op-by-op each evaluation is several executable
+    uploads on a tunneled device)."""
+    return 0.5 * l2 * jnp.sum(c * c) + l1 * jnp.sum(jnp.abs(c))
+
+
 class FixedEffectCoordinate:
     """Global GLM over one feature shard (reference:
     FixedEffectCoordinate.scala).  Normalization is trained-in /
@@ -69,8 +77,14 @@ class FixedEffectCoordinate:
         self.loss = TASK_LOSSES[task_type]
         self.mesh = mesh
         # dense arrays pass through; scipy.sparse shards become PaddedSparse
-        # (the wide-model product path, ops/features.py)
-        self.x = fops.as_feature_matrix(dataset.feature_shards[config.feature_shard])
+        # (the wide-model product path, ops/features.py); single-device
+        # solves also carry the column-sorted gradient stream (no scatter).
+        # The device copy comes from (and is stored back into) the dataset's
+        # shared shard cache so scoring/diagnostics never re-transfer it.
+        self.x = fops.as_feature_matrix(
+            dataset.device_shard(config.feature_shard),
+            with_csc=(mesh is None or mesh.size == 1))
+        dataset._device_shards[config.feature_shard] = self.x
         self.labels = jnp.asarray(dataset.response)
         self.weights = (None if dataset.weights is None
                         else jnp.asarray(dataset.weights))
@@ -146,17 +160,20 @@ class FixedEffectCoordinate:
         """Margin contribution on the TRAINING data, canonical order."""
         return fops.matvec(self.x, model.glm.coefficients.means)
 
-    def regularization_term(self, model: FixedEffectModel) -> float:
+    def regularization_term(self, model: FixedEffectModel) -> jax.Array:
         """reference: Coordinate.computeRegularizationTermValue.  For a
         normalized coordinate the solver penalized the NORMALIZED-space
         coefficients, so the term is computed in that space — keeping the
-        logged objective consistent with the quantity actually minimized."""
+        logged objective consistent with the quantity actually minimized.
+        Returned as a DEVICE scalar so the caller folds it into the
+        objective with one readback (each float() costs a full tunnel
+        round-trip)."""
         opt = self.config.optimization
         l1, l2 = opt.regularization.split(opt.regularization_weight)
         c = model.glm.coefficients.means
         if self.norm is not None:
             c = self.norm.model_to_transformed_space(c)
-        return float(0.5 * l2 * jnp.dot(c, c) + l1 * jnp.sum(jnp.abs(c)))
+        return _penalty(c, l1, l2)
 
 
 class _EntityCoordinateBase:
@@ -173,17 +190,41 @@ class _EntityCoordinateBase:
         self.mesh = mesh
         self.red: RandomEffectDataset = build_random_effect_dataset(
             dataset, config.data_config(seed))
-        self.flat_x = jnp.asarray(dataset.feature_shards[config.feature_shard])
+        self.flat_x = dataset.device_shard(config.feature_shard)
         self.lanes = jnp.asarray(self.red.flat_entity_lanes(
             dataset.entity_indices[config.random_effect_type]))
+        # device copy of the per-entity projection, transferred once (the
+        # model threads the SAME host array through every update)
+        self.proj_dev = (None if self.red.projection is None
+                         else jnp.asarray(self.red.projection))
         self.entity_id_values = np.asarray(
             dataset.entity_vocabs[config.random_effect_type])[self.red.entity_ids]
 
-    def _score_global(self, global_coefficients: jax.Array) -> jax.Array:
+    def _score_model(self, model) -> jax.Array:
         """All rows (active AND passive) scored against their entity's model
         via static gather — the reference's separate passive-data broadcast
-        path (RandomEffectCoordinate.scala:178-210) collapses into this."""
-        return score_by_entity(global_coefficients, self.flat_x, self.lanes)
+        path (RandomEffectCoordinate.scala:178-210) collapses into this.
+        Projection + gather + dot run as ONE fused program (executable
+        uploads over a tunneled device scale with program count)."""
+        from photon_ml_tpu.parallel.random_effect import (
+            score_entities_matmul, score_entities_plain,
+            score_entities_scatter)
+        if isinstance(model, FactoredRandomEffectModel):
+            return score_entities_matmul(model.latent_coefficients,
+                                         model.projection, self.flat_x,
+                                         self.lanes)
+        if model.projection_matrix is not None:
+            return score_entities_matmul(model.coefficients,
+                                         model.projection_matrix,
+                                         self.flat_x, self.lanes)
+        if model.projection is not None:
+            proj = (self.proj_dev if model.projection is self.red.projection
+                    else jnp.asarray(model.projection))
+            return score_entities_scatter(model.coefficients, proj,
+                                          self.flat_x, self.lanes,
+                                          global_dim=model.global_dim)
+        return score_entities_plain(model.coefficients, self.flat_x,
+                                    self.lanes)
 
 
 class RandomEffectCoordinate(_EntityCoordinateBase):
@@ -225,15 +266,15 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
         return new_model, res
 
     def score(self, model: RandomEffectModel) -> jax.Array:
-        return self._score_global(model.global_coefficients())
+        return self._score_model(model)
 
-    def regularization_term(self, model: RandomEffectModel) -> float:
+    def regularization_term(self, model: RandomEffectModel) -> jax.Array:
         """Sum over entities (reference: RandomEffectOptimizationProblem
-        .getRegularizationTermValue — join + map + reduce, here one einsum)."""
+        .getRegularizationTermValue — join + map + reduce, here one einsum);
+        device scalar, folded into the objective readback by the caller."""
         opt = self.config.optimization
         l1, l2 = opt.regularization.split(opt.regularization_weight)
-        c = model.coefficients
-        return float(0.5 * l2 * jnp.sum(c * c) + l1 * jnp.sum(jnp.abs(c)))
+        return _penalty(model.coefficients, l1, l2)
 
 
 class FactoredRandomEffectCoordinate(_EntityCoordinateBase):
@@ -302,21 +343,19 @@ class FactoredRandomEffectCoordinate(_EntityCoordinateBase):
 
     def score(self, model: FactoredRandomEffectModel) -> jax.Array:
         """c_e . (P x) == (C @ P)[e] . x — one [E,k]x[k,d] matmul then the
-        same entity-gather scoring as a plain random effect."""
-        return self._score_global(model.global_coefficients())
+        same entity-gather scoring as a plain random effect, fused."""
+        return self._score_model(model)
 
-    def regularization_term(self, model: FactoredRandomEffectModel) -> float:
+    def regularization_term(self, model: FactoredRandomEffectModel) -> jax.Array:
         """RE term over latent factors + latent-problem term over P
         (reference: FactoredRandomEffectOptimizationProblem
-        .getRegularizationTermValue)."""
+        .getRegularizationTermValue); device scalar, folded into the
+        objective readback by the caller."""
         opt, lat = self.config.optimization, self.config.latent_optimization
         l1, l2 = opt.regularization.split(opt.regularization_weight)
-        c = model.latent_coefficients
-        term = 0.5 * l2 * jnp.sum(c * c) + l1 * jnp.sum(jnp.abs(c))
         pl1, pl2 = lat.regularization.split(lat.regularization_weight)
-        p = model.projection
-        term = term + 0.5 * pl2 * jnp.sum(p * p) + pl1 * jnp.sum(jnp.abs(p))
-        return float(term)
+        return (_penalty(model.latent_coefficients, l1, l2)
+                + _penalty(model.projection, pl1, pl2))
 
 
 Coordinate = (FixedEffectCoordinate | RandomEffectCoordinate

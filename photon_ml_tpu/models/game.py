@@ -45,8 +45,7 @@ class FixedEffectModel:
         return type(self.glm).task_type
 
     def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
-        from photon_ml_tpu.ops import features as fops
-        x = fops.as_feature_matrix(dataset.feature_shards[self.feature_shard])
+        x = dataset.device_shard(self.feature_shard)
         if mesh is not None:
             from photon_ml_tpu.parallel.fixed_effect import score_fixed_effect
             return score_fixed_effect(self.glm, x, mesh)
@@ -56,6 +55,33 @@ class FixedEffectModel:
         c = self.glm.coefficients.means
         return (f"FixedEffectModel(shard={self.feature_shard}, dim={c.shape[-1]}, "
                 f"|w|={float(jnp.linalg.norm(c)):.4g})")
+
+
+def _lanes_for(dataset: GameDataset, re_type: str,
+               entity_ids: np.ndarray) -> np.ndarray:
+    """Map the dataset's entity-index column to model lanes by raw id — the
+    static-gather replacement for the reference's data-keyBy(REId) ⋈ model
+    join (RandomEffectModel.scala:256)."""
+    vocab = dataset.entity_vocabs[re_type]
+    lookup = {v: i for i, v in enumerate(entity_ids.tolist())}
+    vocab_to_lane = np.asarray([lookup.get(v, -1) for v in vocab.tolist()],
+                               dtype=np.int64)
+    idx = dataset.entity_indices[re_type]
+    return np.where(idx >= 0, vocab_to_lane[np.maximum(idx, 0)], -1)
+
+
+def _device_lanes(dataset: GameDataset, re_type: str,
+                  entity_ids: np.ndarray) -> jax.Array:
+    """_lanes_for on device, memoized per (dataset, entity vocabulary): the
+    lane map is identical across every update's rescoring (models are
+    rebuilt per update but share the entity_ids array)."""
+    key = ("lanes", re_type)
+    hit = dataset._scoring_cache.get(key)
+    if hit is not None and hit[0] is entity_ids:
+        return hit[1]
+    lanes = jnp.asarray(_lanes_for(dataset, re_type, entity_ids))
+    dataset._scoring_cache[key] = (entity_ids, lanes)
+    return lanes
 
 
 @dataclasses.dataclass
@@ -100,24 +126,35 @@ class RandomEffectModel:
                                        self.global_dim)
 
     def lanes_for(self, dataset: GameDataset) -> np.ndarray:
-        """Map the dataset's entity-index column to this model's lanes by raw
-        id — the static-gather replacement for the reference's
-        data-keyBy(REId) ⋈ model join (RandomEffectModel.scala:256)."""
-        vocab = dataset.entity_vocabs[self.random_effect_type]
-        lookup = {v: i for i, v in enumerate(self.entity_ids.tolist())}
-        vocab_to_lane = np.asarray([lookup.get(v, -1) for v in vocab.tolist()],
-                                   dtype=np.int64)
-        idx = dataset.entity_indices[self.random_effect_type]
-        lanes = np.where(idx >= 0, vocab_to_lane[np.maximum(idx, 0)], -1)
-        return lanes
+        return _lanes_for(dataset, self.random_effect_type, self.entity_ids)
+
+    def _device_lanes(self, dataset: GameDataset) -> jax.Array:
+        return _device_lanes(dataset, self.random_effect_type,
+                             self.entity_ids)
 
     def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
-        x = jnp.asarray(dataset.feature_shards[self.feature_shard])
-        lanes = jnp.asarray(self.lanes_for(dataset))
+        from photon_ml_tpu.parallel.random_effect import (
+            score_entities_matmul, score_entities_plain,
+            score_entities_scatter)
+        x = dataset.device_shard(self.feature_shard)
+        lanes = self._device_lanes(dataset)
         if mesh is not None:
             n, (x, lanes) = _sharded_rows(mesh, x, lanes)
             return score_by_entity(self.global_coefficients(), x, lanes)[:n]
-        return score_by_entity(self.global_coefficients(), x, lanes)
+        # single fused program per shape (projection + gather + dot): over a
+        # tunneled device each op-by-op program pays an executable upload
+        if self.projection_matrix is not None:
+            return score_entities_matmul(self.coefficients,
+                                         self.projection_matrix, x, lanes)
+        if self.projection is not None:
+            key = ("proj", self.random_effect_type)
+            hit = dataset._scoring_cache.get(key)
+            if hit is None or hit[0] is not self.projection:
+                hit = (self.projection, jnp.asarray(self.projection))
+                dataset._scoring_cache[key] = hit
+            return score_entities_scatter(self.coefficients, hit[1], x,
+                                          lanes, global_dim=self.global_dim)
+        return score_entities_plain(self.coefficients, x, lanes)
 
     def summary(self) -> str:
         return (f"RandomEffectModel(type={self.random_effect_type}, "
@@ -165,6 +202,14 @@ class FactoredRandomEffectModel:
             projection=None, global_dim=self.global_dim)
 
     def score_dataset(self, dataset: GameDataset, mesh=None) -> jax.Array:
+        if mesh is None:
+            from photon_ml_tpu.parallel.random_effect import \
+                score_entities_matmul
+            return score_entities_matmul(
+                self.latent_coefficients, self.projection,
+                dataset.device_shard(self.feature_shard),
+                _device_lanes(dataset, self.random_effect_type,
+                              self.entity_ids))
         return self.to_random_effect_model().score_dataset(dataset, mesh)
 
     def summary(self) -> str:

@@ -75,18 +75,64 @@ class PaddedSparse:
     nse-leading) cannot do.  This is the product path for the reference's
     wide sparse regime (SparseVector features, AvroDataReader.scala:332-440;
     >200k-feature depth switch GameEstimator.scala:667-669).
+
+    The optional `csc_*` arrays are a SECOND, column-sorted view of the same
+    nonzeros for the gradient product X^T u: TPU scatter-add serializes and
+    ran at ~0.1% of HBM roofline (measured, round 4), so `rmatvec` instead
+    gathers u by row, multiplies, cumsums the column-sorted stream, and
+    differences the cumulative sums at column boundaries — gather, multiply,
+    prefix-scan, gather: no scatter anywhere.  Built by `with_csc()`
+    (single-device solves); the GSPMD multi-device path strips them and
+    keeps the row-shardable scatter+psum formulation.
     """
 
     indices: jax.Array   # [n, k] int32, padding = 0
     values: jax.Array    # [n, k], padding = 0.0
     num_cols: int        # static
+    csc_row: jax.Array = None    # [nnz] int32 row ids, column-sorted
+    csc_val: jax.Array = None    # [nnz] values in the same order
+    csc_end: jax.Array = None    # [d+1] int32: nz of column j live in
+    #                              [csc_end[j], csc_end[j+1]) of the stream
 
     def tree_flatten(self):
-        return (self.indices, self.values), self.num_cols
+        return ((self.indices, self.values, self.csc_row, self.csc_val,
+                 self.csc_end), self.num_cols)
 
     @classmethod
     def tree_unflatten(cls, num_cols, children):
-        return cls(children[0], children[1], num_cols)
+        return cls(children[0], children[1], num_cols, *children[2:])
+
+    @property
+    def has_csc(self) -> bool:
+        return self.csc_row is not None
+
+    def with_csc(self) -> "PaddedSparse":
+        """Attach the column-sorted gradient view (host-side prep)."""
+        import numpy as np
+        if self.has_csc:
+            return self
+        ind = np.asarray(self.indices)
+        val = np.asarray(self.values)
+        rows = np.repeat(np.arange(ind.shape[0], dtype=np.int32),
+                         ind.shape[1])
+        cols = ind.reshape(-1)
+        vals = val.reshape(-1)
+        # ELL padding slots (value 0 at column 0) contribute nothing to the
+        # segment sums, so they can stay in the stream; sort by column only
+        order = np.argsort(cols, kind="stable")
+        cols_sorted = cols[order]
+        end = np.zeros(self.num_cols + 1, np.int32)
+        end[1:] = np.cumsum(np.bincount(cols_sorted,
+                                        minlength=self.num_cols))
+        return PaddedSparse(
+            self.indices, self.values, self.num_cols,
+            csc_row=jnp.asarray(rows[order]),
+            csc_val=jnp.asarray(vals[order]),
+            csc_end=jnp.asarray(end))
+
+    def without_csc(self) -> "PaddedSparse":
+        return (PaddedSparse(self.indices, self.values, self.num_cols)
+                if self.has_csc else self)
 
     @property
     def shape(self):
@@ -116,8 +162,10 @@ class PaddedSparse:
         return PaddedSparse(jnp.asarray(indices), jnp.asarray(values), x.shape[1])
 
     @staticmethod
-    def from_scipy(mat) -> "PaddedSparse":
-        """scipy.sparse -> ELL (host-side, no densification)."""
+    def from_scipy(mat, with_csc: bool = False) -> "PaddedSparse":
+        """scipy.sparse -> ELL (host-side, no densification).  `with_csc`
+        also attaches the exact column-sorted gradient view (scipy's own
+        CSC conversion — no ELL padding slots in the stream)."""
         import numpy as np
         csr = mat.tocsr()
         csr.sum_duplicates()
@@ -131,22 +179,44 @@ class PaddedSparse:
                           else np.float32)
         indices[rows, slot] = csr.indices
         values[rows, slot] = csr.data
-        return PaddedSparse(jnp.asarray(indices), jnp.asarray(values),
-                            csr.shape[1])
+        out = PaddedSparse(jnp.asarray(indices), jnp.asarray(values),
+                           csr.shape[1])
+        if with_csc:
+            csc = mat.tocsc()
+            csc.sum_duplicates()
+            out = PaddedSparse(
+                out.indices, out.values, out.num_cols,
+                csc_row=jnp.asarray(csc.indices.astype(np.int32)),
+                csc_val=jnp.asarray(csc.data.astype(values.dtype)),
+                csc_end=jnp.asarray(csc.indptr.astype(np.int32)))
+        return out
 
 
 FeatureMatrix = Union[jax.Array, jsparse.BCOO, KroneckerDesign, PaddedSparse]
 
 
-def as_feature_matrix(x) -> FeatureMatrix:
+# below this width the scatter-add accumulator is small enough that the
+# scatter path wins outright, and the csc stream would only add host->device
+# transfer (measured: yahoo-shape d=14,983 FE pays ~5s extra transfer for no
+# solve-time gain, while d=250k gains 3.7x; see BENCH config 6 vs 7)
+CSC_MIN_COLS = 100_000
+
+
+def as_feature_matrix(x, with_csc: bool = False) -> FeatureMatrix:
     """Ingest adapter: scipy.sparse -> PaddedSparse, everything else as-is
-    (dense arrays pass through jnp.asarray)."""
-    if isinstance(x, (jsparse.BCOO, KroneckerDesign, PaddedSparse)):
+    (dense arrays pass through jnp.asarray).  `with_csc` attaches the
+    column-sorted gradient view to WIDE sparse inputs (single-device
+    solves, >= CSC_MIN_COLS features)."""
+    if isinstance(x, PaddedSparse):
+        return (x.with_csc() if with_csc and x.num_cols >= CSC_MIN_COLS
+                else x)
+    if isinstance(x, (jsparse.BCOO, KroneckerDesign)):
         return x
     try:
         import scipy.sparse as sp
         if sp.issparse(x):
-            return PaddedSparse.from_scipy(x)
+            return PaddedSparse.from_scipy(
+                x, with_csc=with_csc and x.shape[1] >= CSC_MIN_COLS)
     except ImportError:
         pass
     return jnp.asarray(x)
@@ -178,12 +248,34 @@ def matvec(x: FeatureMatrix, v: jax.Array) -> jax.Array:
     return x @ v
 
 
+def _csc_segment_sum(vals: jax.Array, rows: jax.Array, end: jax.Array,
+                     u: jax.Array) -> jax.Array:
+    """sum_j vals_j * u[rows_j] per column, for a column-sorted stream.
+
+    Formulated as gather -> multiply -> prefix-scan -> boundary gather —
+    every op is a TPU-parallel primitive; the scatter-add this replaces
+    serializes on TPU (measured ~0.1% of HBM roofline, BENCH_r04 config 6).
+    f32 cumsum-differencing costs ~eps*|running sum| absolute error per
+    column; l' weights are mixed-sign so the running sum random-walks at
+    ~sqrt(nnz) scale and the noise sits orders below the solver tolerance
+    (validated by the float64-reference parity gate in bench configs 6-7)."""
+    contrib = vals * u.at[rows].get(mode="promise_in_bounds")
+    acc = jnp.promote_types(vals.dtype, u.dtype)
+    c = jnp.cumsum(contrib.astype(acc))
+    c0 = jnp.concatenate([jnp.zeros((1,), acc), c])
+    return (c0.at[end[1:]].get(mode="promise_in_bounds")
+            - c0.at[end[:-1]].get(mode="promise_in_bounds"))
+
+
 def rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
     """X^T @ u -> [d].  The gradient-assembly kernel."""
     if isinstance(x, KroneckerDesign):
         return ((x.factors * u[:, None]).T @ x.x).reshape(-1)
     if isinstance(x, PaddedSparse):
-        # accumulate in the PROMOTED dtype: with bf16 feature storage the
+        if x.has_csc:
+            return _csc_segment_sum(x.csc_val, x.csc_row, x.csc_end, u)
+        # GSPMD multi-device fallback: per-shard scatter-add + psum.
+        # Accumulate in the PROMOTED dtype: with bf16 feature storage the
         # contrib product is f32 and the gradient must not round through a
         # bf16 buffer (the solver state is f32)
         contrib = (x.values * u[:, None]).reshape(-1)
@@ -204,6 +296,9 @@ def sq_rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
         f2 = x.factors * x.factors
         return ((f2 * u[:, None]).T @ (x.x * x.x)).reshape(-1)
     if isinstance(x, PaddedSparse):
+        if x.has_csc:
+            return _csc_segment_sum(x.csc_val * x.csc_val, x.csc_row,
+                                    x.csc_end, u)
         contrib = (x.values * x.values * u[:, None]).reshape(-1)
         acc = jnp.promote_types(x.dtype, u.dtype)
         return jnp.zeros(x.num_cols, acc).at[x.indices.reshape(-1)].add(
@@ -224,7 +319,10 @@ def pad_rows(x: FeatureMatrix, rem: int) -> FeatureMatrix:
     if isinstance(x, KroneckerDesign):
         return KroneckerDesign(zpad(x.x), zpad(x.factors))
     if isinstance(x, PaddedSparse):
-        return PaddedSparse(zpad(x.indices), zpad(x.values), x.num_cols)
+        # the csc stream is untouched: appended rows carry no nonzeros and
+        # existing row ids stay valid against the grown u
+        return PaddedSparse(zpad(x.indices), zpad(x.values), x.num_cols,
+                            x.csc_row, x.csc_val, x.csc_end)
     if is_sparse(x):
         # all-zero rows need no stored elements: only the shape grows
         return jsparse.BCOO((x.data, x.indices), shape=(x.shape[0] + rem,) +

@@ -60,6 +60,13 @@ def pad_batch_to_mesh(objective: GLMObjective, mesh: Mesh) -> GLMObjective:
 
 def shard_objective(objective: GLMObjective, mesh: Mesh) -> GLMObjective:
     """Place the batch with rows sharded over "data" (norm ctx replicated)."""
+    from photon_ml_tpu.ops.features import PaddedSparse
+    if isinstance(objective.x, PaddedSparse) and objective.x.has_csc \
+            and mesh.size > 1:
+        # the column-sorted gradient stream interleaves rows, so it cannot
+        # shard over the data axis; multi-device solves keep the
+        # row-shardable per-shard scatter-add + GSPMD psum formulation
+        objective = objective.replace(x=objective.x.without_csc())
     objective = pad_batch_to_mesh(objective, mesh)
     batch_spec = lambda a: None if a is None else jax.device_put(
         a, data_sharding(mesh, a.ndim))

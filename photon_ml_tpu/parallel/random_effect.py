@@ -179,6 +179,31 @@ def score_entity_blocks(coefficients: jax.Array, blocks: EntityBlocks) -> jax.Ar
     return scores * blocks.mask
 
 
+@functools.partial(jax.jit, static_argnames=("global_dim",))
+def score_entities_scatter(coefficients, projection, x, lanes, *,
+                           global_dim: int) -> jax.Array:
+    """Index-map-projected per-entity scoring, ONE fused program: scatter to
+    global space + entity gather + row dot.  Over a tunneled device every
+    distinct op-by-op program costs a per-process executable upload, and
+    rescoring runs every coordinate update — fusing the chain keeps the
+    warm-start cost at one program per shape."""
+    g = scatter_local_to_global(coefficients, projection, global_dim)
+    return score_by_entity(g, x, lanes)
+
+
+@jax.jit
+def score_entities_matmul(coefficients, projection_matrix, x,
+                          lanes) -> jax.Array:
+    """Dense-projection (random-projection / factored-latent) scoring as one
+    fused program: [E,k] @ [k,d] then entity gather + row dot."""
+    return score_by_entity(coefficients @ projection_matrix, x, lanes)
+
+
+@jax.jit
+def score_entities_plain(coefficients, x, lanes) -> jax.Array:
+    return score_by_entity(coefficients, x, lanes)
+
+
 def score_by_entity(coefficients: jax.Array, x: jax.Array,
                     entity_index: jax.Array) -> jax.Array:
     """Score flat rows against their entity's model: one gather + row dot.
