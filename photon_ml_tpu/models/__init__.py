@@ -9,3 +9,8 @@ from photon_ml_tpu.models.game import (  # noqa: F401
     FactoredRandomEffectModel, FixedEffectModel, GameModel,
     MatrixFactorizationModel, RandomEffectModel,
 )
+from photon_ml_tpu.models.validators import (  # noqa: F401
+    BinaryClassifierAUCValidator, BinaryPredictionValidator,
+    CompositeModelValidator, MaximumDifferenceValidator, ModelValidationError,
+    NonNegativePredictionValidator, PredictionFiniteValidator,
+)

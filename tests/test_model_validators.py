@@ -1,0 +1,132 @@
+"""Composable trained-model validity checks.
+
+reference: photon-api/src/integTest/.../supervised/*Validator.scala — the
+model-validity suite the reference's integration tests compose (finite
+predictions, binary class labels, non-negative means, max error bound,
+minimum AUC).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.models import (
+    BinaryClassifierAUCValidator, BinaryPredictionValidator, Coefficients,
+    CompositeModelValidator, MaximumDifferenceValidator, ModelValidationError,
+    NonNegativePredictionValidator, PredictionFiniteValidator,
+)
+from photon_ml_tpu.models.glm import model_for_task
+
+
+def _model(task, w):
+    return model_for_task(task, Coefficients(jnp.asarray(w, jnp.float32)))
+
+
+def test_finite_validator(rng):
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    m = _model("linear_regression", [1.0, -2.0, 0.5])
+    PredictionFiniteValidator().validate(m, jnp.asarray(x))
+    bad = _model("linear_regression", [np.inf, 0.0, 0.0])
+    with pytest.raises(ModelValidationError, match="NaN or \\+/-Inf"):
+        PredictionFiniteValidator().validate(bad, jnp.asarray(x))
+
+
+def test_binary_prediction_validator(rng):
+    x = rng.normal(size=(40, 2)).astype(np.float32)
+    m = _model("logistic_regression", [1.0, -1.0])
+    BinaryPredictionValidator().validate(m, jnp.asarray(x))
+    reg = _model("linear_regression", [1.0, -1.0])
+    with pytest.raises(ModelValidationError, match="requires a classifier"):
+        BinaryPredictionValidator().validate(reg, jnp.asarray(x))
+    # smoothed hinge is a raw-margin classifier but still emits {0, 1}
+    svm = _model("smoothed_hinge_loss_linear_svm", [1.0, -1.0])
+    BinaryPredictionValidator().validate(svm, jnp.asarray(x))
+
+
+def test_non_negative_validator(rng):
+    x = np.abs(rng.normal(size=(30, 2))).astype(np.float32)
+    poisson = _model("poisson_regression", [0.1, 0.2])
+    NonNegativePredictionValidator().validate(poisson, jnp.asarray(x))
+    linear = _model("linear_regression", [-1.0, -1.0])
+    with pytest.raises(ModelValidationError, match="negative predictions"):
+        NonNegativePredictionValidator().validate(linear, jnp.asarray(x))
+
+
+def test_maximum_difference_validator(rng):
+    x = rng.normal(size=(60, 2)).astype(np.float32)
+    w = np.asarray([1.5, -0.7])
+    y = x @ w
+    m = _model("linear_regression", w)
+    MaximumDifferenceValidator(0.01).validate(m, jnp.asarray(x), y)
+    with pytest.raises(ModelValidationError, match="prediction error"):
+        MaximumDifferenceValidator(0.01).validate(m, jnp.asarray(x), y + 1.0)
+    with pytest.raises(ValueError, match="must be > 0"):
+        MaximumDifferenceValidator(0.0)
+
+
+def test_auc_validator(rng):
+    n = 400
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    w = np.asarray([2.0, -1.0, 0.5])
+    y = (x @ w > 0).astype(np.float64)
+    m = _model("logistic_regression", w)
+    BinaryClassifierAUCValidator(0.95).validate(m, jnp.asarray(x), y)
+    anti = _model("logistic_regression", -w)
+    with pytest.raises(ModelValidationError, match="AUROC"):
+        BinaryClassifierAUCValidator(0.95).validate(anti, jnp.asarray(x), y)
+    with pytest.raises(ValueError, match="minimum_auc"):
+        BinaryClassifierAUCValidator(0.3)
+
+
+def test_composite_validator_shares_predictions(rng, monkeypatch):
+    import photon_ml_tpu.models.validators as mv
+    x = rng.normal(size=(50, 2)).astype(np.float32)
+    w = np.asarray([1.0, 1.0])
+    y = x @ w
+    m = _model("linear_regression", w)
+    calls = {"n": 0}
+    orig = mv._predictions
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(mv, "_predictions", counting)
+    CompositeModelValidator(
+        PredictionFiniteValidator(),
+        MaximumDifferenceValidator(0.5),
+    ).validate(m, jnp.asarray(x), y)
+    assert calls["n"] == 1  # one shared device round trip
+    with pytest.raises(ModelValidationError):
+        CompositeModelValidator(
+            PredictionFiniteValidator(),
+            MaximumDifferenceValidator(0.5),
+        ).validate(m, jnp.asarray(x), y + 3.0)
+    # iterable form + dataclasses.replace both work
+    import dataclasses
+    c = CompositeModelValidator([PredictionFiniteValidator()])
+    dataclasses.replace(c, validators=[PredictionFiniteValidator()]) \
+        .validate(m, jnp.asarray(x), y)
+    # label-requiring validators are named clearly when labels are missing
+    with pytest.raises(ModelValidationError, match="require labels"):
+        CompositeModelValidator(MaximumDifferenceValidator(1.0)) \
+            .validate(m, jnp.asarray(x))
+
+
+def test_binary_validator_reuses_shared_predictions(rng, monkeypatch):
+    """Mean-threshold classifiers derive classes from the shared prediction
+    array inside a composite (no second forward pass)."""
+    import photon_ml_tpu.models.validators as mv
+    x = rng.normal(size=(40, 2)).astype(np.float32)
+    m = _model("logistic_regression", [1.0, -1.0])
+    calls = {"n": 0}
+    orig = mv._predictions
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(mv, "_predictions", counting)
+    CompositeModelValidator(PredictionFiniteValidator(),
+                            BinaryPredictionValidator()) \
+        .validate(m, jnp.asarray(x))
+    assert calls["n"] == 1
