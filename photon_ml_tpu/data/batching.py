@@ -455,6 +455,18 @@ def _build_random_effect_dataset(
     buckets = []
     num_active = len(row_ids_l)
     in_bucket_of_lane = np.searchsorted(bucket_bounds, lane_l, side="right") - 1
+    # pad-row/pad-column trick: one zero row (and, for the index-map
+    # projector, one zero column) appended to the flat arrays lets padding
+    # ids gather ZEROS directly — no [E, S, d]-sized mask multiplies, which
+    # dominated this build at MovieLens-20M scale (measured ~40% of 12s)
+    d_pad = d_global + (1 if projection is not None else 0)
+    x_pad = np.zeros((n + 1, d_pad), x_flat.dtype)  # one copy, final shape
+    x_pad[:n, :d_global] = x_flat
+    y_pad = np.concatenate([y_flat, [_SAFE_LABEL]]).astype(dtype)
+    w_pad = (None if w_flat is None
+             else np.concatenate([w_flat, [0.0]]).astype(dtype))
+    o_pad = (None if o_flat is None
+             else np.concatenate([o_flat, [0.0]]).astype(dtype))
     for b in range(len(bucket_bounds) - 1):
         lb, ub = int(bucket_bounds[b]), int(bucket_bounds[b + 1])
         Eb = ub - lb
@@ -463,24 +475,22 @@ def _build_random_effect_dataset(
         r_ids = np.full((Eb, max(Sb, 1)), -1, dtype=np.int64)
         r_ids[lane_l[sel] - lb, slot_l[sel]] = row_ids_l[sel]
         mask = (r_ids >= 0).astype(dtype)
-        safe_ids = np.maximum(r_ids, 0)
+        gat = np.where(r_ids >= 0, r_ids, n)  # pad cell -> zero row
 
         if projection is not None:
             cols = projection[lb:ub]
-            col_ok = (cols >= 0).astype(dtype)
-            xb = (x_flat[safe_ids[:, :, None], np.maximum(cols, 0)[:, None, :]]
-                  * col_ok[:, None, :] * mask[:, :, None])
+            gcols = np.where(cols >= 0, cols, x_flat.shape[1])  # -> zero col
+            xb = x_pad[gat[:, :, None], gcols[:, None, :]]
         elif proj_matrix is not None:
-            xb = np.einsum("esd,kd->esk",
-                           x_flat[safe_ids] * mask[:, :, None], proj_matrix)
+            xb = np.einsum("esd,kd->esk", x_pad[gat], proj_matrix)
         else:
-            xb = x_flat[safe_ids] * mask[:, :, None]
+            xb = x_pad[gat]
 
-        labels = np.where(mask > 0, y_flat[safe_ids], _SAFE_LABEL)
-        weights = (w_flat[safe_ids] if w_flat is not None
-                   else np.ones_like(mask))
-        weights = weights * mask * weight_scale[perm[lb:ub], None]
-        offsets = None if o_flat is None else o_flat[safe_ids] * mask
+        labels = y_pad[gat]
+        # both the mask and gathered weights are already 0 at padding cells
+        weights = ((w_pad[gat] if w_pad is not None else mask)
+                   * weight_scale[perm[lb:ub], None])
+        offsets = None if o_pad is None else o_pad[gat]
         buckets.append(EntityBucket(
             lane_start=lb,
             blocks=EntityBlocks(

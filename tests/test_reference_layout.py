@@ -206,6 +206,40 @@ def test_our_avro_model_also_readable_as_before(tmp_path, rng):
                                rtol=1e-6)
 
 
+_REAL_FIXTURE = ("/root/reference/photon-client/src/integTest/resources/"
+                 "GameIntegTest/gameModel")
+
+
+@pytest.mark.skipif(not os.path.isdir(_REAL_FIXTURE),
+                    reason="reference checkout not present")
+def test_loads_actual_scala_written_fixture():
+    """The GENUINE artifact: the reference repo's checked-in GAME model
+    directory (written by the Scala implementation itself, used by its
+    scoring DriverTest) must load here directly."""
+    model, config = load_game_model(_REAL_FIXTURE)
+    assert config is None
+    assert model.task_type == "linear_regression"
+    fe = model.coordinates["globalShard"]
+    assert fe.feature_shard == "globalShard"
+    means = np.asarray(fe.glm.coefficients.means)
+    assert means.ndim == 1 and len(means) > 1
+    assert np.isfinite(means).all() and (means != 0).any()
+    maps = load_model_index_maps(_REAL_FIXTURE)
+    m = maps["globalShard"]
+    assert m.size == len(means)
+    # feature identity survives: every nonzero coefficient resolves back to
+    # the (name, term) key the Scala writer recorded
+    j = int(np.flatnonzero(means)[0])
+    name, term = m.name_term(j)
+    assert means[m.index_of(name, term)] == means[j]
+    # scoring runs end-to-end against a synthetic dataset in its space
+    rngl = np.random.default_rng(0)
+    x = rngl.normal(size=(5, m.size))
+    ds = build_game_dataset(np.zeros(5), {"globalShard": x})
+    s = np.asarray(model.score_dataset(ds))
+    np.testing.assert_allclose(s, x @ means, rtol=1e-6)
+
+
 def test_reference_layout_scoring_cli(tmp_path, rng):
     """The scoring CLI accepts a reference-layout model directory directly:
     index maps are rebuilt from the records, so Avro scoring data resolves
