@@ -135,6 +135,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "writes the Scala reference's own directory layout "
                         "(part-*.avro + id-info) that photon-ml itself "
                         "can load")
+    p.add_argument("--initial-model-dir", default=None,
+                   help="warm-start every coordinate this model covers "
+                        "(npz, avro, or a reference-layout directory that "
+                        "actual photon-ml wrote); beyond the reference, "
+                        "whose warm start is intra-sweep only")
     p.add_argument("--checkpoint-dir", default=None,
                    help="persist the model after every outer coordinate-"
                         "descent iteration and resume from the latest "
@@ -434,11 +439,33 @@ def _run(args, log) -> int:
         print(f"profiling to {profile_dir}", file=sys.stderr)
 
     try:
+        initial_model = None
+        if args.initial_model_dir:
+            # cross-job warm start (BEYOND the reference, whose warm start
+            # is intra-sweep only): any supported layout loads here,
+            # including a model directory actual photon-ml wrote.  The
+            # model re-keys into THIS job's feature spaces — a
+            # reference-layout model stores a compact space (zeros
+            # dropped), and a different data slice scans a different
+            # vocabulary, so raw coefficients would misalign.
+            from photon_ml_tpu.models.io import (align_game_model_to_dataset,
+                                                 load_game_model,
+                                                 load_model_index_maps)
+            initial_model, _ = load_game_model(args.initial_model_dir)
+            try:
+                initial_model = align_game_model_to_dataset(
+                    initial_model,
+                    load_model_index_maps(args.initial_model_dir), train)
+            except ValueError as e:
+                raise SystemExit(f"--initial-model-dir: {e}")
+            log.info("warm-starting from %s (%s)", args.initial_model_dir,
+                     list(initial_model.coordinates))
         if args.config:
             with open(args.config) as f:
                 config = GameTrainingConfig.from_json(f.read())
             results = [GameEstimator(config, mesh=mesh, emitter=emitter).fit(
                 train, val, evaluator_specs,
+                initial_model=initial_model,
                 checkpoint_dir=args.checkpoint_dir)]
         else:
             # legacy single-GLM path: one FE coordinate, lambda sweep, best by
@@ -461,7 +488,8 @@ def _run(args, log) -> int:
                 updating_sequence=["fixed"])
             results = GameEstimator(config, mesh=mesh, emitter=emitter).fit_grid(
                 train, grid, val, evaluator_specs, warm_start=args.warm_start,
-                checkpoint_dir=args.checkpoint_dir)
+                checkpoint_dir=args.checkpoint_dir,
+                initial_model=initial_model)
 
         if args.tuning != "none":
             # reference: Driver.runHyperparameterTuning — searcher seeded with
@@ -472,7 +500,8 @@ def _run(args, log) -> int:
                 GameEstimatorEvaluationFunction, GaussianProcessSearch, RandomSearch)
             fn = GameEstimatorEvaluationFunction(
                 GameEstimator(config, mesh=mesh, emitter=emitter), train, val,
-                evaluator_specs, scale="log", warm_start=args.warm_start)
+                evaluator_specs, scale="log", warm_start=args.warm_start,
+                initial_model=initial_model)
             if args.warm_start:
                 for r in results:
                     if r.validation:

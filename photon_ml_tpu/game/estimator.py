@@ -205,6 +205,7 @@ class GameEstimator:
         evaluator_specs: Optional[Sequence[str]] = None,
         warm_start: bool = False,
         checkpoint_dir: Optional[str] = None,
+        initial_model: Optional[GameModel] = None,
     ) -> List[GameResult]:
         """Sweep per-coordinate optimization configs (cartesian product),
         reference: GameTrainingParams.getAllModelConfigs + train-per-config
@@ -221,7 +222,10 @@ class GameEstimator:
         instant no-ops (their checkpoints already cover every iteration)."""
         names = list(grid)
         results: List[GameResult] = []
-        previous: Optional[GameModel] = None
+        # `initial_model` seeds the sweep (cross-job warm start); with
+        # warm_start each combo then chains from the previous combo's model,
+        # without it every combo starts independently from the seed
+        previous: Optional[GameModel] = initial_model
         for i, combo in enumerate(itertools.product(*(grid[n] for n in names))):
             coords = dict(self.config.coordinates)
             for n, opt in zip(names, combo):
@@ -232,7 +236,7 @@ class GameEstimator:
                           os.path.join(checkpoint_dir, f"combo-{i:03d}"))
             results.append(sub.fit(
                 dataset, validation_dataset, evaluator_specs,
-                initial_model=previous if warm_start else None,
+                initial_model=previous if warm_start else initial_model,
                 checkpoint_dir=combo_ckpt))
             previous = results[-1].model
         return results

@@ -35,6 +35,7 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameResult]):
         evaluator_specs: Optional[Sequence[str]] = None,
         scale: str = "log",
         warm_start: bool = False,
+        initial_model=None,
     ):
         if scale not in ("log", "linear"):
             raise ValueError(f"scale must be 'log' or 'linear', got {scale!r}")
@@ -44,8 +45,11 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameResult]):
         self.evaluator_specs = evaluator_specs
         self.scale = scale
         # warm start: each tuning refit initializes from the best model seen
-        # so far (reference: GameTrainingParams.useWarmStart)
+        # so far (reference: GameTrainingParams.useWarmStart);
+        # `initial_model` (cross-job warm start) seeds refits when no better
+        # observation exists yet, or every refit when warm_start is off
         self.warm_start = warm_start
+        self.initial_model = initial_model
         self._best_result: Optional[GameResult] = None
         # sorted for a consistent vector layout (reference uses SortedMap)
         self.coordinate_names = sorted(estimator.config.coordinates)
@@ -97,7 +101,8 @@ class GameEstimatorEvaluationFunction(EvaluationFunction[GameResult]):
     def __call__(self, candidate: np.ndarray) -> Tuple[float, GameResult]:
         config = self._vector_to_config(candidate)
         initial = (self._best_result.model
-                   if self.warm_start and self._best_result is not None else None)
+                   if self.warm_start and self._best_result is not None
+                   else self.initial_model)
         result = GameEstimator(config, self.estimator.mesh,
                                emitter=self.estimator.emitter).fit(
             self.data, self.validation_data, self.evaluator_specs,

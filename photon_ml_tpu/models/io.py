@@ -670,6 +670,99 @@ def load_game_model(directory: str
     return GameModel(coords, task), config
 
 
+def _remap_columns(arr: np.ndarray, source: IndexMap,
+                   target: IndexMap) -> np.ndarray:
+    """Re-key the last axis from `source`'s column order to `target`'s;
+    features absent from the source become 0."""
+    idx = np.asarray([source.key_to_index.get(str(k), -1)
+                      for k in target.index_to_key])
+    gathered = np.asarray(arr)[..., np.maximum(idx, 0)]
+    return np.where(idx >= 0, gathered, 0.0)
+
+
+def align_game_model_to_dataset(model: GameModel,
+                                model_maps: Optional[Dict[str, IndexMap]],
+                                dataset) -> GameModel:
+    """Make a loaded model usable as a warm start for `dataset`: remap each
+    coordinate's coefficients into the dataset's feature spaces.
+
+    A reference-layout model rebuilds a COMPACT feature space from its
+    records (zero coefficients are not stored), and a different data slice
+    scans a different vocabulary — warm-starting raw coefficients would
+    either shape-error or silently bind them to the wrong features.  With
+    index maps on both sides, columns re-key by (name, term) and missing
+    features start at 0; without maps, the dimensions must match exactly.
+    Projected/factored/matrix-factorization coordinates cannot re-key
+    (their local spaces don't carry global feature names) and require
+    identical dimensions."""
+    import dataclasses
+    import jax.numpy as jnp
+    model_maps = model_maps or {}
+    out = {}
+    for name, m in model.coordinates.items():
+        if isinstance(m, FixedEffectModel):
+            shard = m.feature_shard
+            if shard not in dataset.feature_shards:
+                raise ValueError(
+                    f"warm-start coordinate {name!r} scores feature shard "
+                    f"{shard!r}, which the training data does not carry")
+            want = dataset.feature_shards[shard].shape[1]
+            means = np.asarray(m.glm.coefficients.means)
+            mm, tm = model_maps.get(shard), dataset.index_maps.get(shard)
+            if mm is not None and tm is not None and \
+                    list(mm.index_to_key) != list(tm.index_to_key):
+                var = m.glm.coefficients.variances
+                coeffs = Coefficients(
+                    jnp.asarray(_remap_columns(means, mm, tm)),
+                    None if var is None else
+                    jnp.asarray(_remap_columns(np.asarray(var), mm, tm)))
+                m = FixedEffectModel(
+                    m.glm.with_coefficients(coeffs), shard)
+            elif len(means) != want:
+                raise ValueError(
+                    f"warm-start coordinate {name!r} has {len(means)} "
+                    f"coefficients but shard {shard!r} is {want} wide, and "
+                    "no index maps exist on both sides to re-key them by "
+                    "feature name")
+            out[name] = m
+            continue
+        if isinstance(m, RandomEffectModel) and m.projection is None \
+                and m.projection_matrix is None:
+            shard = m.feature_shard
+            want = dataset.feature_shards.get(shard)
+            want = None if want is None else want.shape[1]
+            coefs = np.asarray(m.coefficients)
+            mm, tm = model_maps.get(shard), dataset.index_maps.get(shard)
+            if mm is not None and tm is not None and \
+                    list(mm.index_to_key) != list(tm.index_to_key):
+                m = dataclasses.replace(
+                    m, coefficients=jnp.asarray(_remap_columns(coefs, mm, tm)),
+                    variances=None if m.variances is None else
+                    jnp.asarray(_remap_columns(np.asarray(m.variances),
+                                               mm, tm)),
+                    global_dim=tm.size)
+            elif want is not None and coefs.shape[1] != want:
+                raise ValueError(
+                    f"warm-start coordinate {name!r} has width "
+                    f"{coefs.shape[1]} but shard {shard!r} is {want} wide, "
+                    "and no index maps exist on both sides to re-key")
+            out[name] = m
+            continue
+        # projected / factored / MF coordinates: no global names to re-key
+        shard = getattr(m, "feature_shard", None)
+        if shard is not None and shard in dataset.feature_shards:
+            want = dataset.feature_shards[shard].shape[1]
+            have = getattr(m, "global_dim", want)
+            if have != want:
+                raise ValueError(
+                    f"warm-start coordinate {name!r} ({type(m).__name__}) "
+                    f"lives in a projected space over a {have}-wide shard, "
+                    f"but the training shard {shard!r} is {want} wide — "
+                    "projected coordinates cannot be re-keyed")
+        out[name] = m
+    return GameModel(out, model.task_type)
+
+
 def save_glm(model, directory: str, index_map: Optional[IndexMap] = None,
              extra_metadata: Optional[dict] = None) -> None:
     """Single-GLM save (reference: legacy GLMSuite.writeModelsToHDFS path)."""

@@ -240,6 +240,78 @@ def test_loads_actual_scala_written_fixture():
     np.testing.assert_allclose(s, x @ means, rtol=1e-6)
 
 
+def test_cli_initial_model_dir_warm_start(tmp_path, rng):
+    """--initial-model-dir warm-starts a training job from a saved model
+    directory — here one in the REFERENCE layout — and must not end worse
+    than the job that produced it."""
+    from tests.test_io_cli import _run_cli
+    from photon_ml_tpu.data.game_data import save_game_dataset
+
+    ds, _ = _dataset(rng, n=400, task="logistic")
+    cfg = _config(task="logistic_regression", iters=1)
+    first = GameEstimator(cfg).fit(ds)
+    model_dir = str(tmp_path / "prev")
+    save_game_model_reference_layout(first.model, model_dir)
+    ds_p = str(tmp_path / "ds.npz")
+    save_game_dataset(ds, ds_p)
+    cfg_p = str(tmp_path / "game.json")
+    with open(cfg_p, "w") as f:
+        f.write(cfg.to_json())
+    out_dir = str(tmp_path / "out")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", ds_p, "--task", "logistic_regression",
+                  "--config", cfg_p, "--output-dir", out_dir,
+                  "--initial-model-dir", model_dir])
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["final_objective"] <= first.objective_history[-1] + 1e-4
+
+
+def test_warm_start_rekeys_different_feature_space(tmp_path, rng):
+    """A model whose feature space differs from the training data's (the
+    reference layout stores a COMPACT space — zeros dropped) re-keys by
+    (name, term) on warm start instead of misaligning columns."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.data import build_game_dataset
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+    from photon_ml_tpu.models.glm import model_for_task
+    from photon_ml_tpu.models.io import (align_game_model_to_dataset,
+                                         load_model_index_maps)
+
+    # model space: {a, c} + intercept (b unseen, exact zero dropped)
+    m_map = build_index_map([("a", ""), ("c", "")])
+    means = np.asarray([0.5, -1.0, 2.0])  # a, c, intercept
+    model = GameModel(
+        {"fixed": FixedEffectModel(
+            model_for_task("linear_regression",
+                           Coefficients(jnp.asarray(means))), "global")},
+        "linear_regression")
+    root = str(tmp_path / "m")
+    save_game_model_reference_layout(model, root,
+                                     index_maps={"global": m_map})
+    loaded, _ = load_game_model(root)
+
+    # training space: {a, b, c} + intercept, different column layout
+    t_map = build_index_map([("a", ""), ("b", ""), ("c", "")])
+    ds = build_game_dataset(np.zeros(4),
+                            {"global": np.zeros((4, t_map.size))},
+                            index_maps={"global": t_map})
+    aligned = align_game_model_to_dataset(
+        loaded, load_model_index_maps(root), ds)
+    got = np.asarray(aligned.coordinates["fixed"].glm.coefficients.means)
+    assert got.shape == (t_map.size,)
+    assert got[t_map.index_of("a")] == 0.5
+    assert got[t_map.index_of("b")] == 0.0   # unseen feature starts at 0
+    assert got[t_map.index_of("c")] == -1.0
+    assert got[t_map.intercept_index] == 2.0
+
+    # dimension mismatch without maps on both sides is a hard error
+    ds_nomaps = build_game_dataset(np.zeros(4), {"global": np.zeros((4, 7))})
+    with pytest.raises(ValueError, match="re-key"):
+        align_game_model_to_dataset(loaded, None, ds_nomaps)
+
+
 def test_reference_layout_scoring_cli(tmp_path, rng):
     """The scoring CLI accepts a reference-layout model directory directly:
     index maps are rebuilt from the records, so Avro scoring data resolves
