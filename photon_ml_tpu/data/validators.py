@@ -134,6 +134,18 @@ def validate_game_dataset(
             errors.append(
                 f"Data contains row(s) with non-finite {name}(s): first at "
                 f"row {int(rows[i])} ({name}={vals[i]!r})")
+        if name == "weight":
+            # 'verify and reject' like the GAME driver's checkData
+            # (reference: cli/game/training/Driver.scala:215-240).  This
+            # 1-D check is cheap, so it always counts the FULL array — a
+            # sampled count would understate the problem
+            full = np.asarray(dataset.weights)
+            nonpos = np.isfinite(full) & (full <= 0.0)
+            if nonpos.any():
+                errors.append(
+                    f"Found {int(nonpos.sum())} data points with weights "
+                    f"<= 0 (first at row {_first_bad(nonpos)}). Please "
+                    "fix data set.")
     if errors:
         raise DataValidationError(
             "Data Validation failed:\n" + "\n".join(errors))
