@@ -43,6 +43,29 @@ def _first_bad(mask: np.ndarray) -> int:
     return int(np.argmax(mask))
 
 
+def _positive_weight_errors(dataset: GameDataset) -> List[str]:
+    """'Verify and reject' non-positive sample weights, like the GAME
+    driver's checkData (reference: cli/game/training/Driver.scala:215-240
+    — "Found N data points with weights <= 0. Please fix data set.").
+    Always counts the FULL array: the 1-D scan is cheap and a sampled
+    count would understate the problem."""
+    if dataset.weights is None:
+        return []
+    w = np.asarray(dataset.weights)
+    nonpos = np.isfinite(w) & (w <= 0.0)
+    if not nonpos.any():
+        return []
+    return [f"Found {int(nonpos.sum())} data points with weights <= 0 "
+            f"(first at row {_first_bad(nonpos)}). Please fix data set."]
+
+
+def _check_positive_weights(dataset: GameDataset) -> None:
+    errors = _positive_weight_errors(dataset)
+    if errors:
+        raise DataValidationError(
+            "Data Validation failed:\n" + "\n".join(errors))
+
+
 def _check_label(task_type: str, y: np.ndarray, rows: np.ndarray) -> List[str]:
     errors = []
     if task_type in ("logistic_regression", "smoothed_hinge_loss_linear_svm"):
@@ -82,6 +105,11 @@ def validate_game_dataset(
     """
     validation_type = DataValidationType(validation_type)
     if validation_type is DataValidationType.VALIDATE_DISABLED:
+        # the weights <= 0 rejection still runs: the reference gates its
+        # checkData on a SEPARATE always-on-by-default flag, not on
+        # validation intensity (cli/game/training/Driver.scala:215-240,
+        # GameTrainingParams checkData), and the 1-D scan is cheap
+        _check_positive_weights(dataset)
         return
     n = dataset.num_rows
     if validation_type is DataValidationType.VALIDATE_SAMPLE:
@@ -134,18 +162,7 @@ def validate_game_dataset(
             errors.append(
                 f"Data contains row(s) with non-finite {name}(s): first at "
                 f"row {int(rows[i])} ({name}={vals[i]!r})")
-        if name == "weight":
-            # 'verify and reject' like the GAME driver's checkData
-            # (reference: cli/game/training/Driver.scala:215-240).  This
-            # 1-D check is cheap, so it always counts the FULL array — a
-            # sampled count would understate the problem
-            full = np.asarray(dataset.weights)
-            nonpos = np.isfinite(full) & (full <= 0.0)
-            if nonpos.any():
-                errors.append(
-                    f"Found {int(nonpos.sum())} data points with weights "
-                    f"<= 0 (first at row {_first_bad(nonpos)}). Please "
-                    "fix data set.")
+    errors.extend(_positive_weight_errors(dataset))
     if errors:
         raise DataValidationError(
             "Data Validation failed:\n" + "\n".join(errors))

@@ -66,6 +66,36 @@ def test_different_column_names_remap():
                                np.sort(heart.response))
 
 
+_GAME_IN = ("/root/reference/photon-client/src/integTest/resources/"
+            "GameIntegTest/input")
+
+
+@pytest.mark.skipif(not os.path.isdir(_GAME_IN),
+                    reason="reference checkout not present")
+def test_yahoo_duplicate_features_fixture_rejected():
+    """The reference's REAL Yahoo-music duplicate-features fixture must be
+    rejected at ingest, like its AvroDataReader ('Duplicate features
+    found', AvroDataReaderIntegTest.scala:75-88).  The merged multi-bag
+    read (shard1 = userFeatures + songFeatures, the reference's own
+    featureSectionMap) also ingests the clean records per shard."""
+    from photon_ml_tpu.data.avro_game import read_game_examples
+    p = os.path.join(_GAME_IN, "duplicateFeatures", "yahoo-music-train.avro")
+    with pytest.raises(ValueError, match="[Dd]uplicate feature"):
+        read_game_examples([p], {"global": ["features"]},
+                           id_columns=["userId", "songId"])
+    # the userFeatures/songFeatures bags carry no duplicates: the
+    # reference's shard map reads fine and ids extract from int columns
+    res = read_game_examples(
+        [p], {"shard1": ["userFeatures", "songFeatures"],
+              "shard2": ["userFeatures"], "shard3": ["songFeatures"]},
+        id_columns=["userId", "songId"])
+    ds = res.dataset
+    assert ds.num_rows == 6
+    assert ds.feature_shards["shard1"].shape[1] > \
+        ds.feature_shards["shard2"].shape[1]
+    assert (ds.entity_indices["userId"] >= 0).all()
+
+
 @pytest.mark.parametrize("fixture", ["zero-weights.avro",
                                      "negative-weights.avro"])
 def test_bad_weights_rejected(fixture):
@@ -77,3 +107,7 @@ def test_bad_weights_rejected(fixture):
     res = _read(os.path.join(_BASE, "bad-weights", fixture))
     with pytest.raises(DataValidationError, match="weights <= 0"):
         validate_game_dataset(res.dataset, "linear_regression")
+    # the cheap rejection survives --data-validation disabled, matching
+    # the reference's SEPARATE always-on checkData flag
+    with pytest.raises(DataValidationError, match="weights <= 0"):
+        validate_game_dataset(res.dataset, "linear_regression", "disabled")
