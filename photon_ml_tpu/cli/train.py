@@ -104,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["full", "sample", "disabled"],
                    help="input sanity-check intensity (reference: "
                         "DataValidationType VALIDATE_FULL/SAMPLE/DISABLED)")
+    p.add_argument("--no-weight-check", action="store_true",
+                   help="allow rows with weights <= 0 (the cheap rejection "
+                        "otherwise runs even under --data-validation "
+                        "disabled, like the reference's separate checkData "
+                        "flag)")
     # hyperparameter tuning (reference: GameTrainingParams tuning mode +
     # Driver.runHyperparameterTuning, cli/game/training/Driver.scala:337-373)
     p.add_argument("--tuning", default="none",
@@ -391,9 +396,11 @@ def _run(args, log) -> int:
     if args.config:
         with open(args.config) as f:
             task = GameTrainingConfig.from_json(f.read()).task_type
-    validate_game_dataset(train, task, args.data_validation)
+    validate_game_dataset(train, task, args.data_validation,
+                          check_weights=not args.no_weight_check)
     if val is not None:
-        validate_game_dataset(val, task, args.data_validation)
+        validate_game_dataset(val, task, args.data_validation,
+                              check_weights=not args.no_weight_check)
 
     if args.save_feature_stats:
         # reference: cli/game/training/Driver.calculateAndSaveFeatureShardStats

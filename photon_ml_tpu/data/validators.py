@@ -97,6 +97,7 @@ def validate_game_dataset(
     task_type: str,
     validation_type: DataValidationType = DataValidationType.VALIDATE_FULL,
     seed: int = 0,
+    check_weights: bool = True,
 ) -> None:
     """Raise DataValidationError naming every failed check, or return None.
 
@@ -105,11 +106,13 @@ def validate_game_dataset(
     """
     validation_type = DataValidationType(validation_type)
     if validation_type is DataValidationType.VALIDATE_DISABLED:
-        # the weights <= 0 rejection still runs: the reference gates its
-        # checkData on a SEPARATE always-on-by-default flag, not on
+        # the weights <= 0 rejection still runs by default: the reference
+        # gates its checkData on a SEPARATE on-by-default flag, not on
         # validation intensity (cli/game/training/Driver.scala:215-240,
-        # GameTrainingParams checkData), and the 1-D scan is cheap
-        _check_positive_weights(dataset)
+        # GameTrainingParams checkData) — and like that flag it has its own
+        # opt-out (`check_weights=False` / CLI --no-weight-check)
+        if check_weights:
+            _check_positive_weights(dataset)
         return
     n = dataset.num_rows
     if validation_type is DataValidationType.VALIDATE_SAMPLE:
@@ -162,7 +165,8 @@ def validate_game_dataset(
             errors.append(
                 f"Data contains row(s) with non-finite {name}(s): first at "
                 f"row {int(rows[i])} ({name}={vals[i]!r})")
-    errors.extend(_positive_weight_errors(dataset))
+    if check_weights:
+        errors.extend(_positive_weight_errors(dataset))
     if errors:
         raise DataValidationError(
             "Data Validation failed:\n" + "\n".join(errors))

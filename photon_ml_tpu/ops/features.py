@@ -248,23 +248,63 @@ def matvec(x: FeatureMatrix, v: jax.Array) -> jax.Array:
     return x @ v
 
 
+_CSC_CHUNK = 1 << 16
+
+
 def _csc_segment_sum(vals: jax.Array, rows: jax.Array, end: jax.Array,
                      u: jax.Array) -> jax.Array:
     """sum_j vals_j * u[rows_j] per column, for a column-sorted stream.
 
-    Formulated as gather -> multiply -> prefix-scan -> boundary gather —
-    every op is a TPU-parallel primitive; the scatter-add this replaces
-    serializes on TPU (measured ~0.1% of HBM roofline, BENCH_r04 config 6).
-    f32 cumsum-differencing costs ~eps*|running sum| absolute error per
-    column; l' weights are mixed-sign so the running sum random-walks at
-    ~sqrt(nnz) scale and the noise sits orders below the solver tolerance
-    (validated by the float64-reference parity gate in bench configs 6-7)."""
+    Formulated as gather -> multiply -> CHUNKED prefix-scan -> boundary
+    gather — every op is a TPU-parallel primitive; the scatter-add this
+    replaces serializes on TPU (measured ~0.1% of HBM roofline, BENCH_r04
+    config 6).
+
+    Chunking is a precision device, not a speed one: a single global
+    cumsum accumulates ~eps*sqrt(nnz) rounding noise into every boundary
+    difference, which measurably slowed LBFGS convergence (61 iterations
+    vs 34 on the exact path, BENCH round 5).  With the scan restarted per
+    64k-element chunk, a column contained in one chunk — the overwhelming
+    case at realistic column counts — differences two LOCAL prefixes and
+    the cross-chunk terms cancel EXACTLY (identical floats), so its error
+    is ~eps*sqrt(chunk) instead; only the rare chunk-spanning column sees
+    the coarse chunk-total prefix."""
     contrib = vals * u.at[rows].get(mode="promise_in_bounds")
     acc = jnp.promote_types(vals.dtype, u.dtype)
-    c = jnp.cumsum(contrib.astype(acc))
-    c0 = jnp.concatenate([jnp.zeros((1,), acc), c])
-    return (c0.at[end[1:]].get(mode="promise_in_bounds")
-            - c0.at[end[:-1]].get(mode="promise_in_bounds"))
+    contrib = contrib.astype(acc)
+    nnz = contrib.shape[0]
+    L = _CSC_CHUNK
+    C = -(-max(nnz, 1) // L)
+    local = jnp.cumsum(
+        jnp.pad(contrib, (0, C * L - nnz)).reshape(C, L), axis=1)
+    # chunk_pref[c] = exact-ish sum of all chunks before c (small array:
+    # its own rounding enters only chunk-SPANNING columns)
+    chunk_pref = jnp.concatenate(
+        [jnp.zeros((1,), acc), jnp.cumsum(local[:, -1])])
+
+    def local_prefix(p):
+        """Within-chunk inclusive prefix of the first p%L elements of
+        chunk p//L, and the chunk index."""
+        c, r = p // L, p % L
+        # p == nnz == C*L makes c == C with r == 0: the select discards the
+        # gathered value, but the row index must still honor the in-bounds
+        # promise (both branches execute)
+        loc = jnp.where(
+            r > 0,
+            local.at[jnp.minimum(c, C - 1),
+                     jnp.maximum(r - 1, 0)].get(mode="promise_in_bounds"),
+            jnp.zeros((), acc))
+        return c, loc
+
+    c1, loc1 = local_prefix(end[1:])
+    c0, loc0 = local_prefix(end[:-1])
+    # ORDER MATTERS for the exactness claim: the local difference and the
+    # chunk-prefix difference are formed separately — for a same-chunk
+    # column the latter is x - x == 0.0 exactly, so no large prefix ever
+    # touches the local result
+    cross = (chunk_pref.at[c1].get(mode="promise_in_bounds")
+             - chunk_pref.at[c0].get(mode="promise_in_bounds"))
+    return (loc1 - loc0) + cross
 
 
 def rmatvec(x: FeatureMatrix, u: jax.Array) -> jax.Array:
