@@ -416,8 +416,20 @@ def _run(args, log) -> int:
                            np.asarray(x), train.weights))
             payload = summary.to_dict()
             imap = (train.index_maps or {}).get(shard)
+            if imap is None:
+                log.info("shard %r carries no index map: JSON stats only "
+                         "(FeatureSummarizationResultAvro keys features by "
+                         "name/term)", shard)
             if imap is not None:
                 payload["feature_keys"] = [str(k) for k in imap.index_to_key]
+                # the reference's own interchange format alongside the JSON
+                # (FeatureSummarizationResultAvro, one record per feature;
+                # ModelProcessingUtils.writeBasicStatistics)
+                from photon_ml_tpu.data.avro_io import write_feature_stats_avro
+                avro_dir = os.path.join(stats_dir, shard)
+                os.makedirs(avro_dir, exist_ok=True)
+                write_feature_stats_avro(
+                    os.path.join(avro_dir, "part-00000.avro"), summary, imap)
             with open(os.path.join(stats_dir, f"{shard}.json"), "w") as f:
                 json.dump(payload, f)
         log.info("feature stats saved to %s", stats_dir)

@@ -82,6 +82,14 @@ LATENT_FACTOR_AVRO = {
                {"name": "latentFactor",
                 "type": {"type": "array", "items": "double"}}]}
 
+FEATURE_SUMMARIZATION_RESULT_AVRO = {
+    "name": "FeatureSummarizationResultAvro", "namespace": _NS,
+    "type": "record",
+    "fields": [{"name": "featureName", "type": "string"},
+               {"name": "featureTerm", "type": "string"},
+               {"name": "metrics",
+                "type": {"type": "map", "values": "double"}}]}
+
 
 # -- training data -----------------------------------------------------------
 
@@ -391,6 +399,34 @@ def re_arrays_from_records(recs, index_map: IndexMap
 
 
 # -- scores ------------------------------------------------------------------
+
+
+def write_feature_stats_avro(path: str, summary, index_map: IndexMap) -> None:
+    """Per-feature statistics -> FeatureSummarizationResultAvro records
+    (reference: ModelProcessingUtils.writeBasicStatistics, scala:560-630 —
+    one record per feature with the same metric-map keys)."""
+    mean_abs = summary.mean_abs
+
+    def gen():
+        for j in range(index_map.size):
+            name, term = index_map.name_term(j)
+            yield {"featureName": name, "featureTerm": term,
+                   "metrics": {"max": float(summary.max[j]),
+                               "min": float(summary.min[j]),
+                               "mean": float(summary.mean[j]),
+                               "normL1": float(summary.norm_l1[j]),
+                               "normL2": float(summary.norm_l2[j]),
+                               "numNonzeros": float(summary.num_nonzeros[j]),
+                               "variance": float(summary.variance[j]),
+                               "meanAbs": float(mean_abs[j])}}
+
+    write_container(path, FEATURE_SUMMARIZATION_RESULT_AVRO, gen())
+
+
+def read_feature_stats_avro(path: str):
+    """-> list of (name, term, metrics-dict), record order preserved."""
+    return [(r["featureName"], r["featureTerm"], dict(r["metrics"]))
+            for r in read_container(path)]
 
 
 def write_scores_avro(path: str, model_id: str, scores: np.ndarray,

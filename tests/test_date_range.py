@@ -94,3 +94,14 @@ def test_cli_date_range_and_feature_stats(tmp_path, rng):
     assert stats["count"] == 3 * third
     assert len(stats["mean"]) == imap.size
     assert len(stats["feature_keys"]) == imap.size
+    # the reference's FeatureSummarizationResultAvro interchange records
+    # are written alongside the JSON, one per feature, matching its values
+    from photon_ml_tpu.data.avro_io import read_feature_stats_avro
+    recs = read_feature_stats_avro(os.path.join(
+        out_dir, "feature-stats", "global", "part-00000.avro"))
+    assert len(recs) == len(stats["feature_keys"])
+    by_key = {(n_, t): m for n_, t, m in recs}
+    j = stats["feature_keys"].index("f1\x01")
+    np.testing.assert_allclose(by_key[("f1", "")]["mean"], stats["mean"][j])
+    assert {"max", "min", "mean", "normL1", "normL2", "numNonzeros",
+            "variance"} <= set(recs[0][2])

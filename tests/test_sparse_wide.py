@@ -146,3 +146,37 @@ def test_bf16_values_accumulate_f32_gradient():
     # binary features are exact in bf16, so the results must agree to f32
     np.testing.assert_allclose(np.asarray(g16), np.asarray(g32), rtol=1e-6)
     assert sq_rmatvec(x16, u).dtype == jnp.float32
+
+
+def test_csc_segment_sum_multi_chunk(rng, monkeypatch):
+    """The chunked prefix-scan's MULTI-chunk machinery (chunk_pref gather,
+    cross-chunk differencing, the r==0 select at chunk boundaries, the
+    c==C clamp at an exact-multiple stream length) against a float64
+    reference — _CSC_CHUNK shrunk so a small stream spans many chunks."""
+    from photon_ml_tpu.ops import features as fops
+
+    monkeypatch.setattr(fops, "_CSC_CHUNK", 16)
+    import jax.numpy as jnp
+
+    d = 40
+    for nnz in (16 * 7,          # exact chunk multiple: end[-1] hits c == C
+                16 * 7 + 5,      # ragged tail
+                3):              # sub-chunk degenerate
+        cols = np.sort(rng.integers(0, d, size=nnz).astype(np.int32))
+        # force boundary-aligned column ends: make one column end exactly
+        # at a chunk edge
+        if nnz >= 32:
+            cols[:16] = 0
+            cols[16:] = np.sort(rng.integers(1, d, size=nnz - 16))
+        rows = rng.integers(0, 50, size=nnz).astype(np.int32)
+        vals = rng.normal(size=nnz).astype(np.float32)
+        end = np.zeros(d + 1, np.int32)
+        end[1:] = np.cumsum(np.bincount(cols, minlength=d))
+        u = rng.normal(size=50).astype(np.float32)
+        out = np.asarray(fops._csc_segment_sum(
+            jnp.asarray(vals), jnp.asarray(rows), jnp.asarray(end),
+            jnp.asarray(u)))
+        truth = np.zeros(d)
+        np.add.at(truth, cols, vals.astype(np.float64)
+                  * u[rows].astype(np.float64))
+        np.testing.assert_allclose(out, truth, atol=1e-4)
