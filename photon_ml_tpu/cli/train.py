@@ -48,6 +48,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "separate jobs share identical feature dimensions "
                         "and key->column assignment (reference: "
                         "FeatureIndexingJob + PalDBIndexMapLoader)")
+    p.add_argument("--selected-features", default=None,
+                   help="Avro file of FeatureAvro {name, term} records: "
+                        "restrict training to exactly these features (+ "
+                        "intercept), like the legacy driver's "
+                        "selected-features file (reference: GLMSuite "
+                        "selectedFeaturesFile).  Single-shard Avro input "
+                        "only; exclusive with --index-map-dir")
     p.add_argument("--id-columns", default=None,
                    help="Avro inputs: comma-separated random-effect id tags "
                         "to extract (top-level field or metadataMap key)")
@@ -243,7 +250,8 @@ def _load_dataset(path: str, task: str, args=None, train_dataset=None,
     if path.endswith(".libsvm") or path.endswith(".txt"):
         if pinned_maps is not None:
             raise SystemExit(
-                "--index-map-dir requires Avro training input: LIBSVM "
+                "a pinned feature space (--index-map-dir / "
+                "--selected-features) requires Avro training input: LIBSVM "
                 "features are positional, not (name, term)-keyed")
         x, y = read_libsvm(path)
         return build_game_dataset(y, {"global": x})
@@ -293,8 +301,9 @@ def _load_dataset(path: str, task: str, args=None, train_dataset=None,
         return result.dataset
     if pinned_maps is not None:
         raise SystemExit(
-            "--index-map-dir requires Avro training input; an npz "
-            "GameDataset already carries its feature spaces")
+            "a pinned feature space (--index-map-dir / --selected-features) "
+            "requires Avro training input; an npz GameDataset already "
+            "carries its feature spaces")
     return load_game_dataset(path)
 
 
@@ -363,6 +372,28 @@ def _run(args, log) -> int:
 
     t0 = time.time()
     pinned_maps = None
+    if args.selected_features:
+        # reference: the legacy driver's selected-features file (GLMSuite
+        # selectedFeaturesFile) — a FeatureAvro list freezing the feature
+        # space to exactly those (name, term) keys + intercept
+        if args.index_map_dir:
+            raise SystemExit("--selected-features and --index-map-dir are "
+                             "exclusive (both pin the feature space)")
+        if args.feature_shard_map:
+            raise SystemExit("--selected-features applies to the default "
+                             "single-shard ingest only (the legacy driver's "
+                             "scope); build maps with cli.index for "
+                             "multi-shard jobs")
+        from photon_ml_tpu.data.avro_codec import read_container
+        from photon_ml_tpu.data.index_map import IndexMap, feature_key
+        keys = [feature_key(r["name"], r.get("term") or "")
+                for r in read_container(args.selected_features)]
+        if not keys:
+            raise SystemExit(f"--selected-features {args.selected_features!r}"
+                             " names no features")
+        pinned_maps = {"global": IndexMap.from_keys(keys)}
+        log.info("feature space restricted to %d selected features",
+                 len(keys))
     if args.index_map_dir:
         # frozen shared feature space (reference: FeatureIndexingJob +
         # PalDBIndexMapLoader): jobs trained against the same prebuilt maps
@@ -420,7 +451,7 @@ def _run(args, log) -> int:
                 log.info("shard %r carries no index map: JSON stats only "
                          "(FeatureSummarizationResultAvro keys features by "
                          "name/term)", shard)
-            if imap is not None:
+            else:
                 payload["feature_keys"] = [str(k) for k in imap.index_to_key]
                 # the reference's own interchange format alongside the JSON
                 # (FeatureSummarizationResultAvro, one record per feature;

@@ -96,6 +96,43 @@ def test_yahoo_duplicate_features_fixture_rejected():
     assert (ds.entity_indices["userId"] >= 0).all()
 
 
+_SELECTED = ("/root/reference/photon-client/src/integTest/resources/"
+             "GLMSuiteIntegTest/selectedFeatures.avro")
+
+
+@pytest.mark.skipif(not os.path.exists(_SELECTED),
+                    reason="reference checkout not present")
+def test_selected_features_fixture_restricts_space(tmp_path, rng):
+    """--selected-features with the reference's REAL FeatureAvro fixture
+    (f1.t1, f4.t2) freezes the feature space to those keys + intercept
+    (reference: GLMSuite selectedFeaturesFile)."""
+    from tests.test_io_cli import _run_cli
+    from photon_ml_tpu.data import build_index_map
+    from photon_ml_tpu.data.avro_game import write_game_examples
+    from photon_ml_tpu.models.io import load_game_model, load_model_index_maps
+
+    n = 80
+    imap = build_index_map([("f1", "t1"), ("f2", ""), ("f4", "t2"),
+                            ("f5", "")])
+    x = (rng.uniform(size=(n, imap.size)) < 0.6).astype(float)
+    y = x @ rng.normal(size=imap.size) + 0.1 * rng.normal(size=n)
+    data_p = str(tmp_path / "train.avro")
+    write_game_examples(data_p, y, bags={"features": (x, imap)})
+    out = str(tmp_path / "out")
+    r = _run_cli("photon_ml_tpu.cli.train",
+                 ["--train-data", data_p, "--task", "linear_regression",
+                  "--reg-weights", "1.0", "--output-dir", out,
+                  "--selected-features", _SELECTED])
+    assert r.returncode == 0, r.stderr[-2000:]
+    model, _ = load_game_model(out + "/best")
+    means = np.asarray(model.coordinates["fixed"].glm.coefficients.means)
+    assert len(means) == 3  # f1.t1 + f4.t2 + intercept
+    maps = load_model_index_maps(out + "/best")
+    m = maps["global"]
+    assert m.index_of("f1", "t1") >= 0 and m.index_of("f4", "t2") >= 0
+    assert m.index_of("f2") == -1
+
+
 @pytest.mark.parametrize("fixture", ["zero-weights.avro",
                                      "negative-weights.avro"])
 def test_bad_weights_rejected(fixture):
