@@ -866,6 +866,123 @@ def bench_config7():
 
 
 # --------------------------------------------------------------------------
+# serving benchmark (--serve): online-inference latency trajectory
+# --------------------------------------------------------------------------
+
+def serve_bench(out_path="BENCH_serve.json"):
+    """Synthetic request stream through the full serving pipeline
+    (CompiledScorer + MicroBatcher + registry): concurrent clients fire
+    mixed-size requests, and the result records throughput + latency
+    percentiles + batch occupancy so future PRs have a serving latency
+    trajectory to regress against.  Includes an under-load hot swap so the
+    zero-downtime path is exercised (and timed) every run."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import model_for_task
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+
+    d_g, d_u, E = 32, 16, 20_000
+    rng = np.random.default_rng(31)
+
+    def make_model(scale):
+        fe = FixedEffectModel(
+            model_for_task("logistic_regression", Coefficients(
+                jnp.asarray(scale * rng.normal(size=d_g), jnp.float32))),
+            "global")
+        re = RandomEffectModel(
+            random_effect_type="userId", feature_shard="per_user",
+            task_type="logistic_regression",
+            coefficients=jnp.asarray(
+                scale * rng.normal(size=(E, d_u)), jnp.float32),
+            entity_ids=np.asarray([f"u{i}" for i in range(E)], dtype=object),
+            projection=None, global_dim=d_u)
+        return GameModel({"fixed": fe, "perUser": re}, "logistic_regression")
+
+    n_requests = max(int(2000 * _SCALE), 200)
+    threads = 16
+    sizes = np.minimum(1 + rng.geometric(0.25, size=n_requests), 16)
+    seen = rng.uniform(size=sizes.sum()) < 0.9  # 10% unseen -> FE fallback
+    ent = np.where(seen, rng.integers(0, E, size=sizes.sum()),
+                   rng.integers(E, 2 * E, size=sizes.sum()))
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    requests = []
+    for r in range(n_requests):
+        lo, hi = bounds[r], bounds[r + 1]
+        requests.append((
+            {"global": rng.normal(size=(hi - lo, d_g)).astype(np.float32),
+             "per_user": rng.normal(size=(hi - lo, d_u)).astype(np.float32)},
+            {"userId": np.asarray([f"u{i}" for i in ent[lo:hi]],
+                                  dtype=object)}))
+
+    svc = ScoringService(model=make_model(1.0), config=ServingConfig(
+        max_batch=256, min_bucket=8, max_wait_s=0.002, max_queue=4096))
+    try:
+        t0 = time.perf_counter()
+        warm_compiles = svc.registry.scorer.bucket_compiles
+        errors = []
+
+        def one(req):
+            try:
+                svc.score(*req)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        # swap under load halfway through the stream (background build,
+        # atomic cutover — in-flight batches finish on the old scorer)
+        swap_s = [None]
+
+        def swapper():
+            from photon_ml_tpu.serving import CompiledScorer
+            s0 = time.perf_counter()
+            scorer = CompiledScorer(make_model(1.1), max_batch=256,
+                                    min_bucket=8, version="v2")
+            scorer.warmup()
+            svc.registry.install(scorer, "v2")
+            swap_s[0] = time.perf_counter() - s0
+
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            futs = [pool.submit(one, r) for r in requests[:n_requests // 2]]
+            sw = pool.submit(swapper)
+            futs += [pool.submit(one, r) for r in requests[n_requests // 2:]]
+            for f in futs:
+                f.result()
+            sw.result()
+        wall = time.perf_counter() - t0
+        snap = svc.metrics_snapshot()
+        entry = {
+            "metric": "serving_rows_per_sec",
+            "value": round(int(sizes.sum()) / wall, 1),
+            "unit": "rows/sec",
+            "detail": {
+                "requests": n_requests, "rows": int(sizes.sum()),
+                "threads": threads, "wall_s": round(wall, 3),
+                "requests_per_sec": round(n_requests / wall, 1),
+                "failed_requests": len(errors),
+                "first_errors": errors[:3],
+                "hot_swap_s": (None if swap_s[0] is None
+                               else round(swap_s[0], 3)),
+                "recompiles_after_warmup":
+                    snap["bucket_compiles"] - 0,  # warmup precedes traffic
+                "warm_bucket_programs": warm_compiles,
+                "metrics": snap,
+            },
+        }
+    finally:
+        svc.close()
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(entry, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps(entry), flush=True)
+    return entry
+
+
+# --------------------------------------------------------------------------
 
 def warm_ref_cache():
     """Compute every GLM config's float64 CPU reference (optimum + solve
@@ -1014,5 +1131,7 @@ if __name__ == "__main__":
         _game_ref_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "--warm-ref-cache":
         warm_ref_cache()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
+        serve_bench(*sys.argv[2:3])
     else:
         main()

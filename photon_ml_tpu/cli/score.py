@@ -40,7 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "uid column names (see cli.train)")
     p.add_argument("--evaluators", default=None)
     p.add_argument("--predict", action="store_true",
-                   help="also emit mean predictions (inverse link; npz only)")
+                   help="also emit mean predictions (inverse link); only "
+                        "the npz output format carries them, so combining "
+                        "with --format avro is an error")
     p.add_argument("--mesh", default="auto",
                    help="'auto' = all local devices, 'none', or 'DxF'")
     p.add_argument("--no-compile-cache", action="store_true",
@@ -116,7 +118,14 @@ def require_fully_labeled(ds, purpose: str) -> None:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.predict and args.format == "avro":
+        # ScoringResultAvro records have no prediction field; silently
+        # dropping --predict hid the loss — fail loudly instead
+        parser.error("--predict emits a predictions array that only the npz "
+                     "output format carries; drop --predict or use "
+                     "--format npz")
 
     from photon_ml_tpu.cli.train import make_mesh_from_arg
     from photon_ml_tpu.evaluation import parse_evaluator

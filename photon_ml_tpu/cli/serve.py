@@ -1,0 +1,282 @@
+"""Online scoring service CLI.
+
+The serving counterpart of cli.score: load a GAME model directory (any
+layout `models/io.py` reads — npz, Avro interchange, or a directory the
+Scala reference wrote) into a warmed `ScoringService` and serve it.
+
+HTTP mode (default) — a dependency-free stdlib server:
+
+  python -m photon_ml_tpu.cli.serve --model-dir out/best --port 8080
+
+  POST /score    {"features": {shard: [[...]]}, "ids": {type: [...]},
+                  "timeout_ms": 50}        -> {"scores": [...]}
+  POST /predict  same body                 -> {"predictions": [...]}
+  GET  /metrics                            -> ServingMetrics snapshot
+  POST /swap     {"model_dir": "..."}      -> zero-downtime hot swap
+  POST /rollback                           -> previous version
+  GET  /healthz
+
+  429 = Overloaded (queue full), 504 = DeadlineExceeded, 400 = bad request.
+  SIGUSR1 dumps a metrics snapshot to stderr; --metrics-interval dumps one
+  periodically.
+
+Burst mode (--burst DATA.npz) — drive a synthetic client burst from a
+GameDataset through the full micro-batching pipeline in-process, print the
+metrics snapshot as the last stdout line, and exit; --output writes the
+scores npz (row order preserved) so results can be diffed against
+cli.score on the same data.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-ml-tpu-serve")
+    p.add_argument("--model-dir", required=True,
+                   help="GAME model directory (any layout models/io.py "
+                        "reads)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="HTTP port (0 = ephemeral; the bound port is "
+                        "printed in the startup line)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="micro-batch coalescing window")
+    p.add_argument("--max-batch", type=int, default=1024,
+                   help="max rows per device call (power-of-two rounded)")
+    p.add_argument("--max-queue", type=int, default=4096,
+                   help="pending requests before shedding (Overloaded)")
+    p.add_argument("--min-bucket", type=int, default=8,
+                   help="smallest padded batch bucket")
+    p.add_argument("--default-timeout-ms", type=float, default=None,
+                   help="per-request deadline when the client sets none")
+    p.add_argument("--metrics-interval", type=float, default=0.0,
+                   help="seconds between periodic metrics dumps to stderr "
+                        "(0 = only on SIGUSR1)")
+    p.add_argument("--event-listener", action="append", default=[],
+                   help="dotted EventListener class path (repeatable); "
+                        "receives ScoringBatchEvent/ModelSwapEvent")
+    p.add_argument("--burst", default=None, metavar="DATA",
+                   help="burst mode: npz GameDataset to score as a "
+                        "concurrent request stream, then exit")
+    p.add_argument("--request-rows", type=int, default=1,
+                   help="burst mode: rows per client request")
+    p.add_argument("--threads", type=int, default=8,
+                   help="burst mode: concurrent client threads")
+    p.add_argument("--output", default=None,
+                   help="burst mode: write scores npz (canonical row order)")
+    return p
+
+
+def _build_service(args):
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+    from photon_ml_tpu.utils.events import EventEmitter
+    emitter = None
+    if args.event_listener:
+        emitter = EventEmitter()
+        for dotted in args.event_listener:
+            emitter.register_listener_class(dotted)
+    cfg = ServingConfig(
+        max_wait_s=args.max_wait_ms / 1e3,
+        max_batch=args.max_batch,
+        max_queue=args.max_queue,
+        min_bucket=args.min_bucket,
+        default_timeout_s=(None if args.default_timeout_ms is None
+                           else args.default_timeout_ms / 1e3))
+    return ScoringService(model_dir=args.model_dir, config=cfg,
+                          emitter=emitter)
+
+
+def _dump_metrics(service, stream=sys.stderr):
+    print(json.dumps(service.metrics_snapshot()), file=stream, flush=True)
+
+
+def _install_metrics_hooks(service, interval_s: float):
+    try:  # SIGUSR1 works only on the main thread of the main interpreter
+        signal.signal(signal.SIGUSR1, lambda *_: _dump_metrics(service))
+    except (ValueError, AttributeError, OSError):
+        pass
+    if interval_s > 0:
+        def loop():
+            while True:
+                time.sleep(interval_s)
+                _dump_metrics(service)
+        threading.Thread(target=loop, daemon=True,
+                         name="photon-serving-metrics").start()
+
+
+# -- burst mode ------------------------------------------------------------
+
+def run_burst(service, data_path: str, request_rows: int, threads: int,
+              output: str = None) -> dict:
+    """Concurrent client burst over a GameDataset: split rows into
+    `request_rows`-sized requests, fire them from a thread pool through the
+    micro-batcher, reassemble scores in canonical row order."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from photon_ml_tpu.data.game_data import load_game_dataset
+    ds = load_game_dataset(data_path)
+    scorer = service.registry.scorer
+    n = ds.num_rows
+    chunks = [np.arange(lo, min(lo + request_rows, n))
+              for lo in range(0, n, request_rows)]
+    scores = np.empty(n, np.float64)
+    errors = []
+
+    def one(rows):
+        feats, ids = scorer.requests_from_dataset(ds, rows)
+        try:
+            scores[rows] = service.score(feats, ids)
+        except Exception as e:  # count, keep the burst going
+            errors.append(f"{type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        list(pool.map(one, chunks))
+    wall = time.perf_counter() - t0
+    if output and not errors:
+        np.savez_compressed(output if output.endswith(".npz")
+                            else output + ".npz", scores=scores)
+    snap = service.metrics_snapshot()
+    return {
+        "mode": "burst", "rows": n, "requests": len(chunks),
+        "threads": threads, "wall_s": round(wall, 4),
+        "requests_per_sec": round(len(chunks) / wall, 1),
+        "rows_per_sec": round(n / wall, 1),
+        "failed_requests": len(errors),
+        "first_errors": errors[:3],
+        "output": output,
+        "metrics": snap,
+    }
+
+
+# -- HTTP mode -------------------------------------------------------------
+
+def _make_http_server(service, host: str, port: int):
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import numpy as np
+
+    from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):  # requests are metered, not logged
+            pass
+
+        def _reply(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return {}
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._reply(200, service.metrics_snapshot())
+            elif self.path == "/healthz":
+                self._reply(200, {
+                    "status": "ok",
+                    "model_version": service.model_version})
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                req = self._body()
+            except ValueError as e:
+                return self._reply(400, {"error": f"bad JSON: {e}"})
+            try:
+                if self.path in ("/score", "/predict"):
+                    feats = {s: np.asarray(v, np.float64)
+                             for s, v in (req.get("features") or {}).items()}
+                    ids = {t: np.asarray(v, dtype=object)
+                           for t, v in (req.get("ids") or {}).items()}
+                    timeout = req.get("timeout_ms")
+                    timeout = None if timeout is None else timeout / 1e3
+                    if self.path == "/score":
+                        out = service.score(feats, ids, timeout=timeout)
+                        key = "scores"
+                    else:
+                        out = service.predict(feats, ids, timeout=timeout)
+                        key = "predictions"
+                    self._reply(200, {key: np.asarray(out).tolist(),
+                                      "model_version": service.model_version})
+                elif self.path == "/swap":
+                    if not req.get("model_dir"):
+                        return self._reply(400,
+                                           {"error": "model_dir required"})
+                    v = service.swap(req["model_dir"], req.get("version"))
+                    self._reply(200, {"version": v})
+                elif self.path == "/rollback":
+                    self._reply(200, {"version": service.rollback()})
+                else:
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+            except Overloaded as e:
+                self._reply(429, {"error": str(e)})
+            except DeadlineExceeded as e:
+                self._reply(504, {"error": str(e)})
+            except (ValueError, KeyError) as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from photon_ml_tpu.utils.jax_cache import enable_persistent_cache
+    enable_persistent_cache()
+    t0 = time.perf_counter()
+    service = _build_service(args)
+    load_s = time.perf_counter() - t0
+    if args.burst:
+        try:
+            result = run_burst(service, args.burst, args.request_rows,
+                               args.threads, args.output)
+        finally:
+            service.close()
+        result["model_load_s"] = round(load_s, 3)
+        print(json.dumps(result))
+        return 1 if result["failed_requests"] else 0
+
+    httpd = _make_http_server(service, args.host, args.port)
+    _install_metrics_hooks(service, args.metrics_interval)
+    print(json.dumps({
+        "serving": f"http://{args.host}:{httpd.server_address[1]}",
+        "model_dir": args.model_dir,
+        "model_version": service.model_version,
+        "model_load_s": round(load_s, 3),
+        "buckets": service.registry.scorer.bucket_sizes(),
+        "endpoints": ["/score", "/predict", "/metrics", "/swap",
+                      "/rollback", "/healthz"],
+    }), flush=True)
+    try:
+        httpd.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        service.close()
+        _dump_metrics(service)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

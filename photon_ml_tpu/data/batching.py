@@ -30,6 +30,7 @@ import numpy as np
 
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.parallel.random_effect import EntityBlocks
+from photon_ml_tpu.utils.math import ceil_pow2 as _ceil_pow2
 
 _SAFE_LABEL = 0.5  # valid for every loss family; see pad_batch_to_mesh
 
@@ -288,11 +289,6 @@ def build_random_effect_dataset(
     built = _build_random_effect_dataset(dataset, config, dtype)
     per_ds[key] = built
     return built
-
-
-def _ceil_pow2(v: np.ndarray) -> np.ndarray:
-    """Elementwise smallest power of two >= v (v >= 1)."""
-    return 1 << np.ceil(np.log2(np.maximum(v, 1))).astype(np.int64)
 
 
 def _is_np_dense(x) -> bool:

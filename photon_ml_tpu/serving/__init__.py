@@ -1,0 +1,31 @@
+"""Online scoring subsystem: low-latency request/response GAME inference.
+
+The offline half of this repo (cli.train / cli.score) is batch-oriented;
+this package is the serving half of the ROADMAP north star.  Four pieces:
+
+  - `scorer.CompiledScorer` — a GAME model directory loaded into
+    device-resident arrays (fixed-effect coefficients, stacked random-effect
+    tables with host-side id->row hash maps, MF factors), scoring through
+    ONE pre-jitted program per power-of-two batch bucket so no request ever
+    compiles after warmup.
+  - `batcher.MicroBatcher` — dynamic micro-batching: concurrent score()
+    calls coalesce into one padded device call, with max-wait / max-batch
+    knobs and load shedding (`Overloaded`, `DeadlineExceeded`).
+  - `registry.ModelRegistry` — versioned scorers with zero-downtime hot
+    swap and rollback.
+  - `service.ScoringService` — the assembled in-process service, with
+    `metrics.ServingMetrics` observability (latency percentiles, batch
+    occupancy, entity hit-rate, shed counts) and
+    ScoringBatchEvent/ModelSwapEvent hooks (utils/events.py).
+
+CLI entrypoint: `python -m photon_ml_tpu.cli.serve`.
+"""
+from photon_ml_tpu.serving.batcher import (  # noqa: F401
+    BatcherConfig, DeadlineExceeded, MicroBatcher, Overloaded, ServingError,
+)
+from photon_ml_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from photon_ml_tpu.serving.registry import ModelRegistry  # noqa: F401
+from photon_ml_tpu.serving.scorer import CompiledScorer  # noqa: F401
+from photon_ml_tpu.serving.service import (  # noqa: F401
+    ScoringService, ServingConfig,
+)

@@ -1,0 +1,132 @@
+"""Serving observability: one thread-safe accumulator, JSON out.
+
+Counts requests/rows/batches, shed and deadline failures, entity hit-rate,
+bucket compiles, and model swaps; keeps a bounded ring of request latencies
+for percentile estimates and a running batch-occupancy mean (rows actually
+scored / padded bucket rows — the padding waste of the power-of-two
+bucketing rule, the serving twin of `RandomEffectDataset.padding_stats`).
+
+`snapshot()` is the JSON surface: the serve CLI dumps it on SIGUSR1 and on
+a periodic timer, and `bench.py --serve` records it in BENCH_serve.json.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ServingMetrics:
+    """All mutation behind one lock; snapshot() copies then computes."""
+
+    def __init__(self, latency_window: int = 8192):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batched_rows = 0          # rows through device batches
+        self.bucket_rows = 0           # padded bucket rows those cost
+        self.shed = 0
+        self.deadline_exceeded = 0
+        self.errors = 0
+        self.entity_lookups = 0
+        self.entity_hits = 0
+        self.bucket_compiles = 0
+        self.swaps = 0
+        self.rollbacks = 0
+        self._latencies = collections.deque(maxlen=latency_window)
+        self._queue_wait_sum = 0.0
+        self._score_time_sum = 0.0
+        self._requests_per_batch_sum = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def observe_request(self, latency_s: float, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+            self._latencies.append(latency_s)
+
+    def observe_batch(self, *, rows: int, bucket_rows: int,
+                      num_requests: int, entity_hits: int,
+                      entity_lookups: int, new_compiles: int,
+                      queue_wait_s: float, score_s: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_rows += rows
+            self.bucket_rows += bucket_rows
+            self._requests_per_batch_sum += num_requests
+            self.entity_hits += entity_hits
+            self.entity_lookups += entity_lookups
+            self.bucket_compiles += new_compiles
+            self._queue_wait_sum += queue_wait_s
+            self._score_time_sum += score_s
+
+    def observe_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def observe_deadline(self) -> None:
+        with self._lock:
+            self.deadline_exceeded += 1
+
+    def observe_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def observe_swap(self, rollback: bool = False) -> None:
+        with self._lock:
+            if rollback:
+                self.rollbacks += 1
+            else:
+                self.swaps += 1
+
+    # -- reporting ---------------------------------------------------------
+
+    def snapshot(self, model_version: Optional[str] = None) -> Dict:
+        with self._lock:
+            lat = np.asarray(self._latencies, np.float64)
+            out = {
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "requests_per_batch": round(
+                    self._requests_per_batch_sum / self.batches, 3)
+                if self.batches else None,
+                "batch_occupancy": round(
+                    self.batched_rows / self.bucket_rows, 4)
+                if self.bucket_rows else None,
+                "entity_hit_rate": round(
+                    self.entity_hits / self.entity_lookups, 4)
+                if self.entity_lookups else None,
+                "bucket_compiles": self.bucket_compiles,
+                "shed": self.shed,
+                "deadline_exceeded": self.deadline_exceeded,
+                "errors": self.errors,
+                "swaps": self.swaps,
+                "rollbacks": self.rollbacks,
+                "mean_queue_wait_ms": round(
+                    1e3 * self._queue_wait_sum / self.batches, 3)
+                if self.batches else None,
+                "mean_batch_score_ms": round(
+                    1e3 * self._score_time_sum / self.batches, 3)
+                if self.batches else None,
+            }
+        if lat.size:
+            out["latency_ms"] = {
+                "p50": round(1e3 * float(np.percentile(lat, 50)), 3),
+                "p90": round(1e3 * float(np.percentile(lat, 90)), 3),
+                "p99": round(1e3 * float(np.percentile(lat, 99)), 3),
+                "max": round(1e3 * float(lat.max()), 3),
+                "window": int(lat.size),
+            }
+        else:
+            out["latency_ms"] = None
+        if model_version is not None:
+            out["model_version"] = model_version
+        return out

@@ -1,0 +1,335 @@
+"""Compiled online scorer: a GAME model resident on the device.
+
+The offline scoring path (`GameModel.score_dataset`) builds per-dataset
+caches and is shaped for one huge batch; serving needs the transpose —
+the MODEL stays resident (fixed-effect coefficient vectors, stacked
+random-effect coefficient tables, MF factors, all device arrays built once
+at load), and small request batches stream through ONE pre-jitted program
+per power-of-two batch bucket.  Related work keeps the model on the
+accelerator and amortizes launches over batched requests for exactly this
+reason (Snap ML, arXiv:1803.06333; GPU primal learning, arXiv:2008.03433).
+
+Entity identity is resolved host-side: each random-effect coordinate
+carries an id->row hash map; ids unseen at training time map to row -1 and
+contribute score 0, so such rows fall back to fixed-effect-only scores
+exactly like the offline path (reference: the missing-score default,
+Evaluator.scala:35-45).
+
+Scoring semantics match `GameModel.score_dataset`: the returned value is
+the summed margin contribution of every coordinate, WITHOUT offsets or the
+inverse link (`mean_prediction` applies the link when callers want means).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.models.game import (
+    FactoredRandomEffectModel, FixedEffectModel, GameModel,
+    MatrixFactorizationModel, RandomEffectModel,
+)
+from photon_ml_tpu.ops import losses as L
+from photon_ml_tpu.parallel.random_effect import score_by_entity
+from photon_ml_tpu.utils.math import ceil_pow2
+
+
+@dataclasses.dataclass
+class ScoreBatchResult:
+    """One scored request batch + the stats the metrics accumulator wants."""
+
+    scores: np.ndarray          # [n] margins, request row order
+    num_rows: int
+    buckets: List[int]          # padded bucket size per device call
+    entity_lookups: int         # id resolutions attempted (all RE + MF)
+    entity_hits: int            # resolutions that found a trained row
+    new_compiles: int           # bucket shapes first seen by this call
+
+
+def _id_lookup(entity_ids: np.ndarray) -> dict:
+    """Host-side id -> table-row hash map (the serving replacement for the
+    offline path's per-dataset vocab joins)."""
+    return {v: i for i, v in enumerate(np.asarray(entity_ids).tolist())}
+
+
+def _resolve_lanes(lookup: dict, ids: np.ndarray) -> np.ndarray:
+    return np.fromiter((lookup.get(v, -1) for v in np.asarray(ids).tolist()),
+                       dtype=np.int32, count=len(ids))
+
+
+class CompiledScorer:
+    """Device-resident GAME model + bucket-jitted scoring programs.
+
+    `score(features, ids)` takes per-shard feature rows
+    (`{shard: [n, d]}`) and per-entity-type raw ids (`{re_type: [n]}`),
+    pads each chunk to the smallest power-of-two bucket
+    (`utils.math.ceil_pow2`, the same rule training prep buckets with),
+    and runs one fused XLA program.  `warmup()` pre-compiles every bucket
+    so no request triggers a compile afterwards.
+    """
+
+    def __init__(self, model: GameModel, *, max_batch: int = 1024,
+                 min_bucket: int = 8, version: Optional[str] = None):
+        if max_batch < 1 or min_bucket < 1:
+            raise ValueError("max_batch and min_bucket must be >= 1")
+        self.model = model
+        self.version = version
+        self.max_batch = int(ceil_pow2(max_batch))
+        self.min_bucket = min(int(ceil_pow2(min_bucket)), self.max_batch)
+        self._loss = L.TASK_LOSSES.get(model.task_type)
+
+        # static program structure (baked into _compute) + device tables
+        self._fe_meta: List[Tuple[str, str]] = []          # (name, shard)
+        self._re_meta: List[Tuple[str, str, str]] = []     # (name, shard, re_type)
+        self._mf_meta: List[Tuple[str, str, str]] = []     # (name, row_t, col_t)
+        self._lookups: Dict[str, dict] = {}                # lane key -> id map
+        tables = []
+        shard_dims: Dict[str, int] = {}
+
+        def note_shard(shard, dim, owner):
+            prev = shard_dims.setdefault(shard, int(dim))
+            if prev != int(dim):
+                raise ValueError(
+                    f"coordinate {owner!r} scores shard {shard!r} at width "
+                    f"{int(dim)} but another coordinate uses width {prev}")
+
+        for name, m in model.coordinates.items():
+            if isinstance(m, FixedEffectModel):
+                w = jnp.asarray(m.glm.coefficients.means)
+                note_shard(m.feature_shard, w.shape[-1], name)
+                self._fe_meta.append((name, m.feature_shard))
+                tables.append(w)
+            elif isinstance(m, (RandomEffectModel, FactoredRandomEffectModel)):
+                # stacked per-entity table in the ORIGINAL shard space:
+                # projected/factored coordinates materialize P^T c once at
+                # load so serving is a single gather + row dot per request
+                table = jnp.asarray(m.global_coefficients())
+                note_shard(m.feature_shard, table.shape[-1], name)
+                self._re_meta.append((name, m.feature_shard,
+                                      m.random_effect_type))
+                self._lookups[name] = _id_lookup(m.entity_ids)
+                tables.append(table)
+            elif isinstance(m, MatrixFactorizationModel):
+                self._mf_meta.append((name, m.row_effect_type,
+                                      m.col_effect_type))
+                self._lookups[name + "/row"] = _id_lookup(m.row_ids)
+                self._lookups[name + "/col"] = _id_lookup(m.col_ids)
+                tables.append(jnp.asarray(m.row_factors))
+                tables.append(jnp.asarray(m.col_factors))
+            else:
+                raise TypeError(f"unknown coordinate model type {type(m)}")
+        if not tables:
+            raise ValueError("model has no coordinates to serve")
+        self._tables = tuple(tables)
+        self.feature_shards: Dict[str, int] = shard_dims
+        self.entity_types = sorted(
+            {t for _, _, t in self._re_meta}
+            | {t for _, r, c in self._mf_meta for t in (r, c)})
+        self._dtype = (jnp.result_type(*self._tables) if self._tables
+                       else jnp.float32)
+        # one jitted program, cached per bucket shape; tables are traced
+        # ARGUMENTS (not closed-over constants), so a same-shape hot swap
+        # reuses every compiled bucket program
+        self._program = jax.jit(self._compute)
+        self._seen_buckets: set = set()
+        self.bucket_compiles = 0
+        self.warmup_s = 0.0
+        self.warmed = False
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str, *, max_batch: int = 1024,
+                       min_bucket: int = 8, version: Optional[str] = None,
+                       warmup: bool = True) -> "CompiledScorer":
+        from photon_ml_tpu.models.io import load_game_model
+        model, _config = load_game_model(model_dir)
+        scorer = cls(model, max_batch=max_batch, min_bucket=min_bucket,
+                     version=version)
+        if warmup:
+            scorer.warmup()
+        return scorer
+
+    def bucket_sizes(self) -> List[int]:
+        out, b = [], self.min_bucket
+        while b < self.max_batch:
+            out.append(b)
+            b <<= 1
+        out.append(self.max_batch)
+        return out
+
+    def warmup(self) -> float:
+        """Compile every bucket program now, so no request ever does."""
+        t0 = time.perf_counter()
+        for b in self.bucket_sizes():
+            xs = {s: np.zeros((b, d), np.float64)
+                  for s, d in self.feature_shards.items()}
+            lanes = {k: np.full(b, -1, np.int32) for k in self._lookups}
+            jax.block_until_ready(self._run_bucket(xs, lanes, b))
+        self.warmup_s = time.perf_counter() - t0
+        self.warmed = True
+        return self.warmup_s
+
+    # -- the device program ------------------------------------------------
+
+    def _compute(self, tables, xs, lanes):
+        """Summed coordinate margins for one padded bucket — ONE fused
+        program (FE matvecs + RE gather-dots + MF factor dots), mirroring
+        GameModel.score_dataset coordinate by coordinate."""
+        i = 0
+        total = None
+
+        def add(z):
+            nonlocal total
+            total = z if total is None else total + z
+
+        for _name, shard in self._fe_meta:
+            w = tables[i]; i += 1
+            add(xs[shard] @ w)
+        for name, shard, _re_type in self._re_meta:
+            table = tables[i]; i += 1
+            add(score_by_entity(table, xs[shard], lanes[name]))
+        for name, _row_t, _col_t in self._mf_meta:
+            rf, cf = tables[i], tables[i + 1]; i += 2
+            rl, cl = lanes[name + "/row"], lanes[name + "/col"]
+            ok = (rl >= 0) & (cl >= 0)
+            rfa = rf[jnp.maximum(rl, 0)]
+            cfa = cf[jnp.maximum(cl, 0)]
+            add(jnp.where(ok, jnp.sum(rfa * cfa, axis=-1), 0.0))
+        return total
+
+    def _run_bucket(self, xs, lanes, bucket: int):
+        if bucket not in self._seen_buckets:
+            self._seen_buckets.add(bucket)
+            self.bucket_compiles += 1
+        xs = {s: jnp.asarray(x, self._dtype) for s, x in xs.items()}
+        lanes = {k: jnp.asarray(v) for k, v in lanes.items()}
+        return self._program(self._tables, xs, lanes)
+
+    # -- request scoring ---------------------------------------------------
+
+    def validate_request(self, features: Dict[str, np.ndarray],
+                         ids: Dict[str, np.ndarray]) -> int:
+        """Shape/coverage check -> the request's row count.  Raised errors
+        are per-request (the batcher propagates them to one caller, not the
+        whole batch)."""
+        missing = sorted(set(self.feature_shards) - set(features))
+        if missing:
+            raise ValueError(f"request is missing feature shard(s) {missing}"
+                             f" (model scores {sorted(self.feature_shards)})")
+        n = None
+        for shard, want in self.feature_shards.items():
+            x = np.asarray(features[shard])
+            if x.ndim != 2 or x.shape[1] != want:
+                raise ValueError(
+                    f"feature shard {shard!r} must be [n, {want}], got "
+                    f"shape {x.shape}")
+            if n is None:
+                n = x.shape[0]
+            elif x.shape[0] != n:
+                raise ValueError(
+                    f"feature shard {shard!r} has {x.shape[0]} rows; other "
+                    f"shards have {n}")
+        missing_ids = sorted(set(self.entity_types) - set(ids or {}))
+        if missing_ids:
+            raise ValueError(
+                f"request is missing entity id column(s) {missing_ids} "
+                f"(model has random effects over {self.entity_types})")
+        for t in self.entity_types:
+            col = np.asarray(ids[t])
+            if n is None:
+                n = len(col)
+            if col.shape != (n,):
+                raise ValueError(
+                    f"id column {t!r} must be [{n}], got shape {col.shape}")
+        if n is None or n == 0:
+            raise ValueError("empty request")
+        return n
+
+    def _lanes_for_chunk(self, ids, lo, hi):
+        lanes, hits, lookups = {}, 0, 0
+        for name, _shard, re_type in self._re_meta:
+            ln = _resolve_lanes(self._lookups[name],
+                                np.asarray(ids[re_type])[lo:hi])
+            lanes[name] = ln
+            hits += int((ln >= 0).sum()); lookups += len(ln)
+        for name, row_t, col_t in self._mf_meta:
+            for side, t in (("/row", row_t), ("/col", col_t)):
+                ln = _resolve_lanes(self._lookups[name + side],
+                                    np.asarray(ids[t])[lo:hi])
+                lanes[name + side] = ln
+                hits += int((ln >= 0).sum()); lookups += len(ln)
+        return lanes, hits, lookups
+
+    def score(self, features: Dict[str, np.ndarray],
+              ids: Optional[Dict[str, np.ndarray]] = None,
+              ) -> ScoreBatchResult:
+        """Margins for a request batch of any size (chunked at max_batch)."""
+        ids = ids or {}
+        n = self.validate_request(features, ids)
+        out = np.empty(n, np.float64)
+        buckets: List[int] = []
+        hits = lookups = 0
+        compiles0 = self.bucket_compiles
+        for lo in range(0, n, self.max_batch):
+            hi = min(lo + self.max_batch, n)
+            m = hi - lo
+            bucket = min(max(int(ceil_pow2(m)), self.min_bucket),
+                         self.max_batch)
+            pad = bucket - m
+            xs = {}
+            for shard in self.feature_shards:
+                x = np.asarray(features[shard])[lo:hi]
+                xs[shard] = (x if pad == 0 else
+                             np.pad(x, ((0, pad), (0, 0))))
+            lanes, h, lk = self._lanes_for_chunk(ids, lo, hi)
+            if pad:
+                lanes = {k: np.pad(v, (0, pad), constant_values=-1)
+                         for k, v in lanes.items()}
+            hits += h; lookups += lk
+            buckets.append(bucket)
+            z = self._run_bucket(xs, lanes, bucket)
+            out[lo:hi] = np.asarray(z)[:m]
+        return ScoreBatchResult(
+            scores=out, num_rows=n, buckets=buckets,
+            entity_lookups=lookups, entity_hits=hits,
+            new_compiles=self.bucket_compiles - compiles0)
+
+    def mean_prediction(self, scores: np.ndarray,
+                        offsets: Optional[np.ndarray] = None) -> np.ndarray:
+        """Inverse link over margins (+ offsets), like GameModel.predict."""
+        if self._loss is None:
+            raise ValueError(
+                f"task {self.model.task_type!r} has no mean function")
+        z = np.asarray(scores, np.float64)
+        if offsets is not None:
+            z = z + np.asarray(offsets, np.float64)
+        return np.asarray(self._loss.mean(jnp.asarray(z)))
+
+    def requests_from_dataset(self, dataset, rows: np.ndarray
+                              ) -> Tuple[Dict[str, np.ndarray],
+                                         Dict[str, np.ndarray]]:
+        """Slice a GameDataset into (features, ids) request form — raw ids
+        recovered through the dataset vocab; rows whose entity index is -1
+        get a sentinel id no model contains (they stay fixed-effect-only).
+        Sparse shards densify per request slice (serving requests are
+        small dense rows by construction)."""
+        def slice_rows(x):
+            if hasattr(x, "tocsr"):  # scipy sparse shard
+                return np.asarray(x.tocsr()[rows].todense())
+            return np.asarray(x)[rows]
+
+        feats = {s: slice_rows(dataset.feature_shards[s])
+                 for s in self.feature_shards}
+        ids = {}
+        for t in self.entity_types:
+            idx = np.asarray(dataset.entity_indices[t])[rows]
+            vocab = np.asarray(dataset.entity_vocabs[t], dtype=object)
+            raw = vocab[np.maximum(idx, 0)].copy()
+            raw[idx < 0] = "\0__unseen__"
+            ids[t] = raw
+        return feats, ids

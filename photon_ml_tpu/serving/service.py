@@ -1,0 +1,158 @@
+"""ScoringService: the assembled in-process online scorer.
+
+Wires the pieces together: a ModelRegistry holding the live CompiledScorer,
+a MicroBatcher coalescing concurrent `score()` calls into padded device
+batches, and ServingMetrics + ScoringBatchEvent observability.  This is the
+object the serve CLI (and any embedding process) talks to:
+
+    svc = ScoringService(model_dir="out/best")
+    scores = svc.score({"global": x, "per_user": xu},
+                       {"userId": ids}, timeout=0.05)
+    svc.swap("out/next")        # zero-downtime hot swap
+    svc.rollback()              # back to the previous version
+    svc.metrics_snapshot()      # JSON observability
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from photon_ml_tpu.serving.batcher import (BatcherConfig, MicroBatcher,
+                                           ServingError)
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.registry import ModelRegistry
+from photon_ml_tpu.serving.scorer import CompiledScorer
+from photon_ml_tpu.utils.events import EventEmitter, ScoringBatchEvent
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Service knobs (CLI flags map 1:1 onto these)."""
+
+    max_wait_s: float = 0.002       # micro-batch coalescing window
+    max_batch: int = 1024           # rows per device call (pow-2 rounded)
+    max_queue: int = 4096           # pending requests before shedding
+    min_bucket: int = 8             # smallest padded batch bucket
+    default_timeout_s: Optional[float] = None  # per-request deadline
+    latency_window: int = 8192      # latency ring for percentiles
+
+
+class ScoringService:
+    def __init__(self, model_dir: Optional[str] = None,
+                 model=None, config: Optional[ServingConfig] = None,
+                 emitter: Optional[EventEmitter] = None):
+        if (model_dir is None) == (model is None):
+            raise ValueError("pass exactly one of model_dir / model")
+        self.config = config or ServingConfig()
+        self.emitter = emitter
+        self.metrics = ServingMetrics(self.config.latency_window)
+        cfg = self.config
+
+        def factory(version_dir, version):
+            if version_dir is None:  # initial in-memory model
+                scorer = CompiledScorer(model, max_batch=cfg.max_batch,
+                                        min_bucket=cfg.min_bucket,
+                                        version=version)
+                scorer.warmup()
+                return scorer
+            return CompiledScorer.from_model_dir(
+                version_dir, max_batch=cfg.max_batch,
+                min_bucket=cfg.min_bucket, version=version)
+
+        self.registry = ModelRegistry(factory, emitter=emitter,
+                                      metrics=self.metrics)
+        self.registry.load(model_dir, version=None if model_dir else "inline@1")
+        self._batcher = MicroBatcher(
+            self._score_batch,
+            BatcherConfig(max_wait_s=cfg.max_wait_s, max_batch=cfg.max_batch,
+                          max_queue=cfg.max_queue),
+            on_shed=self.metrics.observe_shed,
+            on_deadline=self.metrics.observe_deadline)
+        self._closed = False
+
+    # -- scoring -----------------------------------------------------------
+
+    def score(self, features: Dict[str, np.ndarray],
+              ids: Optional[Dict[str, np.ndarray]] = None,
+              timeout: Optional[float] = None) -> np.ndarray:
+        """Margins for one request (batched with concurrent callers).
+        Raises Overloaded / DeadlineExceeded under load, ValueError on a
+        malformed request."""
+        ids = ids or {}
+        # validate against the CURRENT scorer before queueing so malformed
+        # requests fail their caller alone, never a whole device batch
+        n = self.registry.scorer.validate_request(features, ids)
+        if timeout is None:
+            timeout = self.config.default_timeout_s
+        t0 = time.monotonic()
+        try:
+            scores = self._batcher.score(features, ids, n, timeout=timeout)
+        except ServingError:
+            raise  # shed/deadline already counted by the batcher hooks
+        except Exception:
+            self.metrics.observe_error()
+            raise
+        self.metrics.observe_request(time.monotonic() - t0, n)
+        return scores
+
+    def predict(self, features, ids=None, offsets=None,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Mean predictions (inverse link), like GameModel.predict."""
+        scores = self.score(features, ids, timeout=timeout)
+        return self.registry.scorer.mean_prediction(scores, offsets)
+
+    def _score_batch(self, features, ids, *, num_requests: int,
+                     queue_wait_s: float):
+        scorer = self.registry.scorer  # resolved per batch: swap boundary
+        t0 = time.monotonic()
+        result = scorer.score(features, ids)
+        score_s = time.monotonic() - t0
+        self.metrics.observe_batch(
+            rows=result.num_rows, bucket_rows=sum(result.buckets),
+            num_requests=num_requests, entity_hits=result.entity_hits,
+            entity_lookups=result.entity_lookups,
+            new_compiles=result.new_compiles,
+            queue_wait_s=queue_wait_s, score_s=score_s)
+        if self.emitter is not None:
+            self.emitter.send_event(ScoringBatchEvent(
+                time=time.time(), num_requests=num_requests,
+                num_rows=result.num_rows, bucket_size=max(result.buckets),
+                queue_wait_s=queue_wait_s, score_s=score_s,
+                model_version=scorer.version))
+        return result
+
+    # -- model lifecycle ---------------------------------------------------
+
+    def swap(self, model_dir: str, version: Optional[str] = None) -> str:
+        """Blocking zero-downtime swap; requests keep flowing on the old
+        model until the new one is warm."""
+        return self.registry.load(model_dir, version)
+
+    def swap_async(self, model_dir: str, version: Optional[str] = None):
+        return self.registry.load_async(model_dir, version)
+
+    def rollback(self) -> str:
+        return self.registry.rollback()
+
+    @property
+    def model_version(self) -> Optional[str]:
+        return self.registry.version
+
+    # -- observability / lifecycle ----------------------------------------
+
+    def metrics_snapshot(self) -> Dict:
+        return self.metrics.snapshot(model_version=self.registry.version)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -53,6 +53,33 @@ class OptimizationLogEvent(Event):
     final_metrics: Dict[str, float]
 
 
+@dataclasses.dataclass
+class ScoringBatchEvent(Event):
+    """One coalesced serving device-batch (serving/): how many concurrent
+    requests were batched, how full the padded bucket was, and where the
+    time went — the observability hook for the online scoring service."""
+
+    time: float
+    num_requests: int
+    num_rows: int
+    bucket_size: int
+    queue_wait_s: float
+    score_s: float
+    model_version: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ModelSwapEvent(Event):
+    """A serving hot swap (or rollback): the registry atomically replaced
+    the live scorer; in-flight batches finished on the previous version."""
+
+    time: float
+    version: str
+    previous_version: Optional[str]
+    action: str = "swap"  # "swap" | "rollback"
+    warmup_s: float = 0.0
+
+
 class EventListener:
     """reference: EventListener.scala — handle() + close()."""
 
@@ -84,9 +111,26 @@ class EventEmitter:
 
     def register_listener_class(self, dotted_path: str) -> None:
         """'pkg.module.ClassName' -> instantiate and register (reference:
-        Driver.scala:108-118 registering listeners by class name)."""
+        Driver.scala:108-118 registering listeners by class name).  The path
+        comes straight from a CLI flag, so failures name the offending path
+        instead of surfacing a bare AttributeError/ImportError."""
         module_name, _, cls_name = dotted_path.rpartition(".")
-        cls = getattr(importlib.import_module(module_name), cls_name)
+        if not module_name:
+            raise ValueError(
+                f"event-listener path {dotted_path!r} is not a dotted "
+                "'pkg.module.ClassName' path")
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError as e:
+            raise ValueError(
+                f"cannot import module {module_name!r} from event-listener "
+                f"path {dotted_path!r}: {e}") from e
+        try:
+            cls = getattr(module, cls_name)
+        except AttributeError:
+            raise ValueError(
+                f"module {module_name!r} has no attribute {cls_name!r} "
+                f"(from event-listener path {dotted_path!r})") from None
         self.register_listener(cls())
 
     def clear_listeners(self) -> None:

@@ -20,7 +20,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: only the XLA_FLAGS path above exists (and suffices as long
+    # as no plugin initialized a backend before this conftest ran)
+    pass
 jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: repeated test runs skip recompilation.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
