@@ -866,6 +866,283 @@ def bench_config7():
 
 
 # --------------------------------------------------------------------------
+# pipelined coordinate descent benchmark (--pipeline): strict vs pipelined
+# --------------------------------------------------------------------------
+
+def _pipeline_dataset(n, d_global, n_users, d_user, seed,
+                      n_items=0, d_item=0):
+    """Seeded GLMix-shaped synthetic data with CONTROLLED entity geometry:
+    round-robin entity assignment gives every entity exactly n/n_users
+    rows (one S-bucket, no ragged tail), so the strict-vs-pipelined pair
+    measures the loop structure, not bucketing noise.  Arrays stay numpy
+    float64 — the device copies follow jax's ambient default dtype (f32 in
+    a bench invocation, f64 under the x64 test fixture), keeping every
+    descent-internal array one consistent dtype."""
+    from photon_ml_tpu.data.game_data import build_game_dataset
+    rng = np.random.default_rng(seed)
+    xg = rng.normal(size=(n, d_global)); xg[:, -1] = 1.0
+    xu = rng.normal(size=(n, d_user)); xu[:, -1] = 1.0
+    users = np.arange(n) % n_users
+    w_g = rng.normal(size=d_global)
+    w_u = rng.normal(size=(n_users, d_user)) * 0.5
+    z = xg @ w_g + np.einsum("nd,nd->n", xu, w_u[users])
+    shards = {"global": xg, "per_user": xu}
+    entity_ids = {"userId": np.asarray([f"u{u:06d}" for u in users])}
+    if n_items:
+        xi = rng.normal(size=(n, d_item)); xi[:, -1] = 1.0
+        items = np.arange(n) % n_items
+        w_i = rng.normal(size=(n_items, d_item)) * 0.5
+        z = z + np.einsum("nd,nd->n", xi, w_i[items])
+        shards["per_item"] = xi
+        entity_ids["itemId"] = np.asarray([f"i{i:06d}" for i in items])
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(float)
+    ds = build_game_dataset(y, shards, entity_ids=entity_ids)
+    rows = np.arange(n)
+    return ds.subset(rows[: int(n * 0.95)]), ds.subset(rows[int(n * 0.95):])
+
+
+def _pipeline_config(outer, solver_iters, with_item, seed=3, history=10,
+                     projector="index_map"):
+    """GAME config for the pipeline pair.  The tuned entries use ONE
+    quasi-Newton step per coordinate update (inexact block coordinate
+    descent — the regime where the loop structure, not the inner solver,
+    dominates) and projector="identity" (dense synthetic shards: the
+    per-entity local space equals the global space, so the index-map
+    scatter buys nothing)."""
+    from photon_ml_tpu.game import (FixedEffectCoordinateConfig,
+                                    GameTrainingConfig, GLMOptimizationConfig,
+                                    RandomEffectCoordinateConfig)
+    from photon_ml_tpu.optim import (OptimizerConfig, RegularizationContext,
+                                     RegularizationType)
+    l2 = RegularizationContext(RegularizationType.L2)
+    opt = lambda w: GLMOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=solver_iters,
+                                  history=history),
+        regularization=l2, regularization_weight=w)
+    coords = {"fixed": FixedEffectCoordinateConfig("global", opt(1.0)),
+              "perUser": RandomEffectCoordinateConfig(
+                  "userId", "per_user", opt(1.0), projector=projector)}
+    seq = ["fixed", "perUser"]
+    if with_item:
+        coords["perItem"] = RandomEffectCoordinateConfig(
+            "itemId", "per_item", opt(1.0), projector=projector)
+        seq.append("perItem")
+    return GameTrainingConfig(task_type="logistic_regression",
+                              coordinates=coords, updating_sequence=seq,
+                              num_outer_iterations=outer, seed=seed)
+
+
+def _run_descent_mode(coords, cfg, train, val, specs, mode, ckpt_dir):
+    """One timed descent-loop run (coordinates pre-built: both modes share
+    the same device-resident data and compiled programs, so the pair
+    isolates the loop structure itself)."""
+    from photon_ml_tpu.game.coordinate_descent import (PhaseTimings,
+                                                       run_coordinate_descent)
+    spans = PhaseTimings()
+    t0 = time.perf_counter()
+    res = run_coordinate_descent(
+        coords, cfg.updating_sequence, cfg.num_outer_iterations, train,
+        cfg.task_type, validation_dataset=val, validation_specs=specs,
+        checkpoint_dir=ckpt_dir, timings=spans, timing_mode=mode)
+    wall = time.perf_counter() - t0
+    ckpt_s = sum(v for k, v in spans.items()
+                 if k.endswith("/checkpoint") or k == "checkpoint/join")
+    return res, {"fit_s": round(wall, 3),
+                 "host_blocked_s": round(spans.host_blocked_total(), 3),
+                 "host_blocked_frac": round(
+                     spans.host_blocked_total() / max(wall, 1e-9), 4),
+                 "checkpoint_spans_s": round(ckpt_s, 3)}
+
+
+def _models_bit_identical(model_a, model_b, tmp_root) -> bool:
+    """Save both GameModels and compare every persisted array bit-for-bit
+    (the acceptance gate: strict and pipelined model DIRECTORIES match)."""
+    import glob as _glob
+
+    from photon_ml_tpu.models.io import save_game_model
+    dirs = []
+    for tag, m in (("a", model_a), ("b", model_b)):
+        d = os.path.join(tmp_root, tag)
+        save_game_model(m, d)
+        dirs.append(d)
+    files_a = sorted(_glob.glob(os.path.join(dirs[0], "**", "*.npz"),
+                                recursive=True))
+    files_b = sorted(_glob.glob(os.path.join(dirs[1], "**", "*.npz"),
+                                recursive=True))
+    if [os.path.relpath(f, dirs[0]) for f in files_a] != \
+            [os.path.relpath(f, dirs[1]) for f in files_b]:
+        return False
+    for fa, fb in zip(files_a, files_b):
+        with np.load(fa, allow_pickle=True) as za, \
+                np.load(fb, allow_pickle=True) as zb:
+            if sorted(za.files) != sorted(zb.files):
+                return False
+            for k in za.files:
+                a, b = za[k], zb[k]
+                if a.dtype == object or b.dtype == object:
+                    if not np.array_equal(a, b):
+                        return False
+                elif a.tobytes() != b.tobytes():  # BIT-identical, not approx
+                    return False
+    return True
+
+
+def _pipeline_entry(name, n, d_global, n_users, d_user, outer, solver_iters,
+                    seed, n_items=0, d_item=0, history=10,
+                    projector="index_map"):
+    """strict-vs-pipelined pair for one GAME shape.  Warmup first (1 outer
+    iteration, pipelined — compiles every program both modes use), then
+    pipelined, then strict, so any residual cache warming favors STRICT
+    (the conservative direction for the reported speedup)."""
+    import tempfile
+
+    from photon_ml_tpu.game import GameEstimator
+    from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+
+    train, val = _pipeline_dataset(n, d_global, n_users, d_user, seed,
+                                   n_items=n_items, d_item=d_item)
+    cfg = _pipeline_config(outer, solver_iters, with_item=n_items > 0,
+                           seed=seed, history=history, projector=projector)
+    est = GameEstimator(cfg)
+    t0 = time.perf_counter()
+    coords = est._build_coordinates(train)
+    build_s = time.perf_counter() - t0
+    specs = est._validation_specs(["AUC"])
+    _log(f"pipeline[{name}]: coordinates built in {build_s:.1f}s; warmup")
+    with tempfile.TemporaryDirectory() as tmp:
+        # warmup: compile everything once, prime the page cache
+        warm_cfg = _pipeline_config(1, solver_iters, with_item=n_items > 0,
+                                    seed=seed, history=history,
+                                    projector=projector)
+        run_coordinate_descent(
+            coords, warm_cfg.updating_sequence, 1, train, warm_cfg.task_type,
+            validation_dataset=val, validation_specs=specs,
+            checkpoint_dir=os.path.join(tmp, "warm"),
+            timing_mode="pipelined")
+        modes = {}
+        results = {}
+        for mode in ("pipelined", "strict"):
+            _log(f"pipeline[{name}]: timing {mode}")
+            results[mode], modes[mode] = _run_descent_mode(
+                coords, cfg, train, val, specs, mode,
+                os.path.join(tmp, mode))
+        gap = max((abs(a - b) for a, b in
+                   zip(results["strict"].objective_history,
+                       results["pipelined"].objective_history)), default=0.0)
+        bit_identical = _models_bit_identical(
+            results["strict"].model, results["pipelined"].model,
+            os.path.join(tmp, "cmp"))
+    speedup = modes["strict"]["fit_s"] / max(modes["pipelined"]["fit_s"], 1e-9)
+    return {
+        "name": name, "task": "logistic_regression",
+        "data": "synthetic-replica", "n_train": train.num_rows,
+        "n_validation": val.num_rows, "outer_iterations": outer,
+        "entities": {"userId": n_users, **({"itemId": n_items}
+                                           if n_items else {})},
+        "model_mb": round((n_users * d_user + n_items * d_item
+                           + d_global) * 4 / 1e6, 1),
+        "build_s": round(build_s, 2),
+        "strict": modes["strict"], "pipelined": modes["pipelined"],
+        "speedup": round(speedup, 3),
+        "objective_history_max_abs_gap": float(gap),
+        "final_model_bit_identical": bit_identical,
+        "parity_ok": bool(gap <= 1e-9 and bit_identical),
+    }
+
+
+def pipeline_bench(out_path="BENCH_pipeline.json"):
+    """Strict-vs-pipelined wall-clock on GAME shapes where the loop
+    structure matters: a checkpoint-heavy per-user shape (big [E, d] model,
+    quick solves — the async writer's coalescing carries the win) and a
+    three-coordinate convex shape (per-update syncs/readbacks scale with
+    coordinate count).  Each entry reports the host-blocked fraction and a
+    hard parity gate (identical objective history to 1e-9 + bit-identical
+    final model directories)."""
+    # long-tail GLMix regime (GLMix's raison d'etre: very many entities,
+    # a handful of rows each, inexact one-step coordinate updates): the
+    # per-iteration checkpoint — [E, d]-scale model serialization — rivals
+    # the device work, which is exactly where strict mode's synchronous
+    # write blocks the loop and the async writer's keep-latest coalescing
+    # pays.  On a 1-core CPU host the concurrency is time-sliced, so the
+    # measured speedup is the ELIMINATED work (coalesced writes, batched
+    # readbacks), a lower bound on what an accelerator-attached host sees.
+    entries = [
+        _pipeline_entry("glmix_longtail_100k_users_ckpt",
+                        n=max(int(100_000 * _SCALE), 4000), d_global=16,
+                        n_users=max(int(100_000 * _SCALE), 4000), d_user=192,
+                        outer=10, solver_iters=1, history=1, seed=3,
+                        projector="identity"),
+        _pipeline_entry("game_fe_2re_three_coordinate_ckpt",
+                        n=max(int(100_000 * _SCALE), 4000), d_global=16,
+                        n_users=max(int(100_000 * _SCALE), 4000), d_user=64,
+                        outer=10, solver_iters=1, history=1, seed=5,
+                        n_items=max(int(50_000 * _SCALE), 2000), d_item=64,
+                        projector="identity"),
+    ]
+    fast_enough = sum(e["speedup"] >= 1.2 for e in entries)
+    result = {
+        "metric": "pipelined_vs_strict_speedup",
+        "value": max(e["speedup"] for e in entries),
+        "unit": "x",
+        "detail": {
+            "entries": entries,
+            "configs_at_or_above_1p2x": fast_enough,
+            "all_parity_ok": all(e["parity_ok"] for e in entries),
+        },
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
+# smoke benchmark (--smoke): tiny, seconds, CPU-safe, no reference solves
+# --------------------------------------------------------------------------
+
+def smoke_bench(out_path="BENCH_smoke.json"):
+    """One tiny GLM solve + one tiny strict-vs-pipelined GAME pair: the
+    bench harness end-to-end in seconds, CPU-safe, no scipy/f64 reference
+    fits and no shared-cache writes — so bench-harness regressions surface
+    in the tier-1 suite (tests/test_bench_smoke.py) instead of only at
+    bench time.  Speed numbers here are smoke signals, not benchmarks."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.synthetic_bench import make_a1a_like
+    from photon_ml_tpu.optim import (OptimizerConfig, RegularizationContext,
+                                     RegularizationType)
+    t_suite = time.perf_counter()
+    x, y = make_a1a_like(1, "logistic", seed=42)
+    res, wall, compile_s = time_glm_solve(
+        "logistic_regression", x, y,
+        OptimizerConfig(max_iterations=25, tolerance=1e-7),
+        RegularizationContext(RegularizationType.L2), 1.0, reps=1)
+    glm = {"name": "smoke_a1a_logistic", "n": int(x.shape[0]),
+           "d": int(x.shape[1]), "wall_s": round(wall, 3),
+           "compile_s": round(compile_s, 2),
+           "final_value_finite": bool(np.isfinite(float(res.value)))}
+
+    game = _pipeline_entry("smoke_glmix_pipeline", n=3000, d_global=8,
+                           n_users=150, d_user=4, outer=2, solver_iters=10,
+                           seed=9)
+    result = {
+        "metric": "bench_smoke_wall_s",
+        "value": round(time.perf_counter() - t_suite, 2),
+        "unit": "s",
+        "detail": {"glm": glm, "game_pipeline": game,
+                   "parity_ok": game["parity_ok"]},
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 # serving benchmark (--serve): online-inference latency trajectory
 # --------------------------------------------------------------------------
 
@@ -1133,5 +1410,9 @@ if __name__ == "__main__":
         warm_ref_cache()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
         serve_bench(*sys.argv[2:3])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--pipeline":
+        pipeline_bench(*sys.argv[2:3])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
+        smoke_bench(*sys.argv[2:3])
     else:
         main()

@@ -158,6 +158,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "record on restart; sweeps checkpoint per grid "
                         "combo (the reference restarts failed jobs from "
                         "scratch)")
+    p.add_argument("--timing-mode", default="pipelined",
+                   choices=["pipelined", "strict"],
+                   help="pipelined (default): device work for the next "
+                        "coordinate is enqueued while the previous one's "
+                        "bookkeeping is in flight — objectives/metrics "
+                        "fetched in one batched readback per outer "
+                        "iteration, checkpoints written by a background "
+                        "thread.  strict: sync after every update (same "
+                        "math bit-for-bit; per-phase timings stay "
+                        "attributable to the device work they launched)")
     return p
 
 
@@ -516,7 +526,8 @@ def _run(args, log) -> int:
             results = [GameEstimator(config, mesh=mesh, emitter=emitter).fit(
                 train, val, evaluator_specs,
                 initial_model=initial_model,
-                checkpoint_dir=args.checkpoint_dir)]
+                checkpoint_dir=args.checkpoint_dir,
+                timing_mode=args.timing_mode)]
         else:
             # legacy single-GLM path: one FE coordinate, lambda sweep, best by
             # first validation evaluator (reference: Driver stage machine +
@@ -539,7 +550,7 @@ def _run(args, log) -> int:
             results = GameEstimator(config, mesh=mesh, emitter=emitter).fit_grid(
                 train, grid, val, evaluator_specs, warm_start=args.warm_start,
                 checkpoint_dir=args.checkpoint_dir,
-                initial_model=initial_model)
+                initial_model=initial_model, timing_mode=args.timing_mode)
 
         if args.tuning != "none":
             # reference: Driver.runHyperparameterTuning — searcher seeded with
@@ -581,6 +592,10 @@ def _run(args, log) -> int:
             "final_objective": best.objective_history[-1],
             "validation": best.validation,
             "wall_s": round(time.time() - t0, 2),
+            "timing_mode": args.timing_mode,
+            "host_blocked_s": round(
+                getattr(getattr(best.descent, "timings", None),
+                        "host_blocked_total", lambda: 0.0)(), 3),
             "compile_s": round(compile_tracker.seconds, 2),
             "compile_count": compile_tracker.count,
             "compile_cache": cache_dir,

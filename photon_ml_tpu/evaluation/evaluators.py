@@ -13,12 +13,22 @@ Rebuild of the reference evaluation stack:
 AUC is the rank-statistic (Mann-Whitney) formulation — one sort, tie-aware —
 rather than the reference's threshold sweep; identical value, TPU-friendly.
 Grouped metrics use one lexicographic argsort + contiguous group slices.
+
+Every built-in ungrouped metric also exists as a jitted DEVICE kernel
+(`Evaluator.device_fn`): the pipelined coordinate-descent loop evaluates
+validation metrics as device scalars and fetches them in one batched
+readback per outer iteration, instead of round-tripping the full [n] score
+vector through numpy float64 per coordinate update.  The numpy versions
+stay the parity-tested float64 reference.
 """
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Dict, Optional, Sequence  # noqa: F401
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.ops import losses as L
@@ -57,12 +67,63 @@ def rmse(scores, labels, weights=None) -> float:
 
 def _loss_metric(loss: L.PointwiseLoss):
     def fn(scores, labels, weights=None) -> float:
-        import jax.numpy as jnp
-        z, y = jnp.asarray(_np(scores)), jnp.asarray(_np(labels))
+        # device arrays pass straight through: forcing them via np.asarray
+        # would round-trip [n] floats to the host and back per evaluation
+        conv = lambda a: a if isinstance(a, jax.Array) else jnp.asarray(_np(a))
+        z, y = conv(scores), conv(labels)
         l = loss.loss(z, y)
-        w = jnp.ones_like(z) if weights is None else jnp.asarray(_np(weights))
+        w = jnp.ones_like(z) if weights is None else conv(weights)
         return float(jnp.sum(w * l) / jnp.sum(w))
     return fn
+
+
+# ---------------------------------------------------------------------------
+# device-side metric kernels (pipelined coordinate descent)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def device_auc(scores, labels, weights=None) -> jax.Array:
+    """Tie-aware weighted AUC as ONE device program returning a device
+    scalar: argsort + two cumulative scans, same midrank algebra as
+    `area_under_roc_curve` (which remains the float64 parity oracle).
+
+    Per tie group G the contribution is wp_G * (wn_below_G + wn_G/2); here
+    each element reads its group's bounds from prefix/suffix fills over the
+    nondecreasing negative-weight cumsum: a group START carries the weight
+    strictly below the group (cummax forward-fill), a group END carries the
+    weight through the group (reverse cummin — the nearest end at-or-after
+    has the smallest cumsum among ends)."""
+    s = scores
+    w = jnp.ones_like(s) if weights is None else weights
+    pos = labels > 0.5
+    order = jnp.argsort(s, stable=True)
+    ss, ws, ps = s[order], w[order], pos[order]
+    wn = jnp.where(ps, jnp.zeros_like(ws), ws)
+    wp = jnp.where(ps, ws, jnp.zeros_like(ws))
+    cn = jnp.cumsum(wn)
+    cn_ex = cn - wn
+    changed = ss[1:] != ss[:-1]
+    new_g = jnp.concatenate([jnp.ones((1,), bool), changed])
+    end_g = jnp.concatenate([changed, jnp.ones((1,), bool)])
+    below = jax.lax.cummax(jnp.where(new_g, cn_ex, -jnp.inf))
+    through = jax.lax.cummin(jnp.where(end_g, cn, jnp.inf), reverse=True)
+    wp_total, wn_total = jnp.sum(wp), jnp.sum(wn)
+    auc = (jnp.sum(wp * (below + 0.5 * (through - below)))
+           / (wp_total * wn_total))
+    return jnp.where((wp_total > 0) & (wn_total > 0), auc, jnp.nan)
+
+
+@jax.jit
+def device_rmse(scores, labels, weights=None) -> jax.Array:
+    w = jnp.ones_like(scores) if weights is None else weights
+    return jnp.sqrt(jnp.sum(w * (scores - labels) ** 2) / jnp.sum(w))
+
+
+@functools.partial(jax.jit, static_argnames=("loss",))
+def device_mean_loss(scores, labels, weights=None, *, loss) -> jax.Array:
+    l = loss.loss(scores, labels)
+    w = jnp.ones_like(scores) if weights is None else weights
+    return jnp.sum(w * l) / jnp.sum(w)
 
 
 def precision_at_k(k: int, scores, labels, weights=None) -> float:
@@ -77,14 +138,27 @@ def precision_at_k(k: int, scores, labels, weights=None) -> float:
 
 @dataclasses.dataclass(frozen=True)
 class Evaluator:
-    """name + metric + direction.  reference: Evaluator.betterThan."""
+    """name + metric + direction.  reference: Evaluator.betterThan.
+
+    `device_fn`, when present, is a jitted kernel computing the SAME metric
+    as a device scalar (no host sync) — the pipelined descent loop batches
+    these readbacks at outer-iteration boundaries.  Custom evaluators
+    without one fall back to the host path (which forces a sync)."""
 
     name: str
     fn: Callable
     larger_is_better: bool
+    device_fn: Optional[Callable] = None
 
     def __call__(self, scores, labels, weights=None) -> float:
         return self.fn(scores, labels, weights)
+
+    def evaluate_on_device(self, scores, labels, weights=None):
+        """Device-scalar evaluation, or None when this metric has no device
+        kernel (callers fall back to the host path)."""
+        if self.device_fn is None:
+            return None
+        return self.device_fn(scores, labels, weights)
 
     def better_than(self, a: float, b: float) -> bool:
         if np.isnan(a):
@@ -141,13 +215,22 @@ class MultiEvaluator:
         return a > b if self.larger_is_better else a < b
 
 
-AUC = Evaluator("AUC", area_under_roc_curve, larger_is_better=True)
-RMSE = Evaluator("RMSE", rmse, larger_is_better=False)
-LOGISTIC_LOSS = Evaluator("LOGISTIC_LOSS", _loss_metric(L.LOGISTIC), larger_is_better=False)
-SQUARED_LOSS = Evaluator("SQUARED_LOSS", _loss_metric(L.SQUARED), larger_is_better=False)
-POISSON_LOSS = Evaluator("POISSON_LOSS", _loss_metric(L.POISSON), larger_is_better=False)
+def _device_loss(loss):
+    return functools.partial(device_mean_loss, loss=loss)
+
+
+AUC = Evaluator("AUC", area_under_roc_curve, larger_is_better=True,
+                device_fn=device_auc)
+RMSE = Evaluator("RMSE", rmse, larger_is_better=False, device_fn=device_rmse)
+LOGISTIC_LOSS = Evaluator("LOGISTIC_LOSS", _loss_metric(L.LOGISTIC), larger_is_better=False,
+                          device_fn=_device_loss(L.LOGISTIC))
+SQUARED_LOSS = Evaluator("SQUARED_LOSS", _loss_metric(L.SQUARED), larger_is_better=False,
+                         device_fn=_device_loss(L.SQUARED))
+POISSON_LOSS = Evaluator("POISSON_LOSS", _loss_metric(L.POISSON), larger_is_better=False,
+                         device_fn=_device_loss(L.POISSON))
 SMOOTHED_HINGE_LOSS = Evaluator("SMOOTHED_HINGE_LOSS", _loss_metric(L.SMOOTHED_HINGE),
-                                larger_is_better=False)
+                                larger_is_better=False,
+                                device_fn=_device_loss(L.SMOOTHED_HINGE))
 
 _BY_NAME = {e.name: e for e in (AUC, RMSE, LOGISTIC_LOSS, SQUARED_LOSS,
                                 POISSON_LOSS, SMOOTHED_HINGE_LOSS)}

@@ -63,15 +63,34 @@ class PhaseTimings(dict):
     stage, photon-lib/.../util/Timer.scala:32-234 used ~30x).  Spans are
     CONTIGUOUS over the descent loop so their sum accounts for the whole
     fit wall-clock — an unattributed gap means an untimed stage, which is
-    exactly what round 3's bench suffered from."""
+    exactly what round 3's bench suffered from.
+
+    `host_blocked` tracks, per span label, the seconds the host spent
+    BLOCKED on device readbacks (scalar syncs, `float()` objective fetches,
+    [n]-array transfers into numpy evaluators, the pipelined boundary
+    flush).  host_blocked_total()/wall is the host-blocked fraction bench
+    reports per config — the quantity pipelining exists to shrink."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.host_blocked: Dict[str, float] = {}
 
     @contextlib.contextmanager
-    def span(self, label: str):
+    def span(self, label: str, host_blocked: bool = False):
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self[label] = self.get(label, 0.0) + time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self[label] = self.get(label, 0.0) + dt
+            if host_blocked:
+                self.add_blocked(label, dt)
+
+    def add_blocked(self, label: str, seconds: float) -> None:
+        self.host_blocked[label] = self.host_blocked.get(label, 0.0) + seconds
+
+    def host_blocked_total(self) -> float:
+        return float(sum(self.host_blocked.values()))
 
     def total(self) -> float:
         return float(sum(self.values()))
@@ -88,14 +107,18 @@ def _data_term(total_scores, base_offsets, labels, weights, *, loss):
     return jnp.sum(l if weights is None else weights * l)
 
 
-def _sync(*arrays) -> None:
-    """True device sync via a scalar readback.  Over the axon tunnel
-    block_until_ready returns BEFORE execution completes; only a
-    device->host readback orders the timeline, so every timing span that
-    launches device work ends with one (cost: one [1] DMA)."""
+def _sync(*arrays) -> float:
+    """True device sync via a scalar readback, returning the seconds the
+    host was blocked (callers feed PhaseTimings.add_blocked).  Over the
+    axon tunnel block_until_ready returns BEFORE execution completes; only
+    a device->host readback orders the timeline, so every STRICT-mode
+    timing span that launches device work ends with one (cost: one [1]
+    DMA).  Pipelined mode skips these entirely — that is the point."""
+    t0 = time.perf_counter()
     for a in arrays:
         if a is not None and hasattr(a, "ravel"):
             float(jnp.asarray(a).ravel()[-1])
+    return time.perf_counter() - t0
 
 
 @dataclasses.dataclass
@@ -220,6 +243,100 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
     logger.info("checkpoint: iteration %d saved to %s", iteration, path)
 
 
+class AsyncCheckpointer:
+    """Background checkpoint writer: iteration *k*'s models serialize while
+    iteration *k+1* trains (the reference has no checkpointing at all, and
+    the strict-mode path here blocks the whole loop on every write).
+
+    Semantics:
+      - writes run on ONE worker thread through the same `_write_checkpoint`,
+        so the atomic write-state-last + prune discipline is untouched and
+        records land in submission order;
+      - keep-latest coalescing: a snapshot superseded before its write
+        STARTS is dropped (only the newest record is ever resumed from, so
+        a skipped intermediate costs nothing on resume — this is what keeps
+        the trainer from ever waiting on a slow disk);
+      - durability: after `shutdown()` (called at fit end) the LAST
+        submitted iteration is on disk; mid-fit, the newest record is
+        whichever submission last finished — a crash resumes from there and
+        retrains the rest;
+      - a worker failure (disk full, ...) surfaces at the next submit() or
+        at shutdown(), never silently.
+    """
+
+    def __init__(self, directory: str):
+        import threading
+
+        self.directory = directory
+        self._cv = threading.Condition()
+        self._pending: Optional[tuple] = None
+        self._busy = False
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self.written = 0
+        self.coalesced = 0
+        self._thread = threading.Thread(
+            target=self._run, name="photon-async-checkpoint", daemon=True)
+        self._thread.start()
+
+    def submit(self, iteration: int, model: GameModel,
+               objective_history: List[float],
+               validation_history: Dict[str, List[float]],
+               best_model: GameModel, best_metric: Optional[float],
+               fingerprint: Optional[str]) -> None:
+        """Enqueue one snapshot (histories are copied here; model objects
+        are immutable and their device buffers are never donated — see the
+        copy-on-alias guards in game/coordinates.py)."""
+        snap = (iteration, model, list(objective_history),
+                {k: list(v) for k, v in validation_history.items()},
+                best_model, best_metric, fingerprint)
+        with self._cv:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    "async checkpoint write failed") from err
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer already shut down")
+            if self._pending is not None:
+                self.coalesced += 1
+            self._pending = snap
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closed:
+                    self._cv.wait()
+                if self._pending is None:
+                    return
+                snap, self._pending = self._pending, None
+                self._busy = True
+            try:
+                _write_checkpoint(self.directory, *snap)
+                with self._cv:
+                    self.written += 1
+            except BaseException as e:  # surfaced at submit/shutdown
+                with self._cv:
+                    self._error = e
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def shutdown(self, raise_errors: bool = True) -> None:
+        """Drain the queue (the final snapshot always writes), stop the
+        worker, and re-raise any worker failure."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            while self._pending is not None or self._busy:
+                self._cv.wait()
+        self._thread.join()
+        if raise_errors and self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
+
+
 def read_checkpoint(directory: str,
                     fingerprint: Optional[str] = None
                     ) -> Optional[CheckpointState]:
@@ -284,6 +401,7 @@ def run_coordinate_descent(
     resume: Optional[CheckpointState] = None,
     checkpoint_fingerprint: Optional[str] = None,
     timings: Optional[PhaseTimings] = None,
+    timing_mode: str = "pipelined",
 ) -> CoordinateDescentResult:
     """reference: CoordinateDescent.run/optimize (scala:57-385).
 
@@ -292,7 +410,24 @@ def run_coordinate_descent(
     read_checkpoint) continues from such a record — a capability the
     reference does NOT have (driver failure there restarts the job from
     scratch, SURVEY §5.3).  Use GameEstimator.fit(checkpoint_dir=...) for
-    the integrated save-and-resume flow."""
+    the integrated save-and-resume flow.
+
+    `timing_mode` (Snap ML-style pipelining, arXiv:1803.06333):
+      - "pipelined" (default): coordinate *k+1*'s device work is enqueued
+        while *k*'s bookkeeping is in flight.  Objectives and validation
+        metrics stay DEVICE scalars, fetched in one batched
+        `jax.device_get` per outer iteration; checkpoints serialize on a
+        background thread (AsyncCheckpointer).  Math is identical to
+        strict mode — same programs, same order — so histories and final
+        coefficients match bit-for-bit.
+      - "strict": every update syncs before the next begins (the
+        pre-pipelining behavior).  Use when per-phase PhaseTimings spans
+        must stay attributable to the device work they launched.
+    """
+    if timing_mode not in ("pipelined", "strict"):
+        raise ValueError(f"timing_mode must be 'pipelined' or 'strict', "
+                         f"got {timing_mode!r}")
+    pipelined = timing_mode == "pipelined"
     loss = TASK_LOSSES[task_type]
     spans = PhaseTimings() if timings is None else timings
     with spans.span("init/transfer"):
@@ -301,7 +436,8 @@ def run_coordinate_descent(
                    else jnp.asarray(dataset.weights))
         base_offsets = (jnp.zeros(dataset.num_rows) if dataset.offsets is None
                         else jnp.asarray(dataset.offsets))
-        _sync(labels, weights, base_offsets)
+        spans.add_blocked("init/transfer",
+                          _sync(labels, weights, base_offsets))
 
     # per-coordinate regularization terms as DEVICE scalars, recomputed
     # ONLY for the updated coordinate and folded into the data term so each
@@ -311,10 +447,13 @@ def run_coordinate_descent(
     # tunnel round-trip each)
     reg_terms: Dict[str, object] = {}
 
-    def training_objective(total_scores) -> float:
-        return float(_data_term(total_scores, base_offsets, labels,
-                                weights, loss=loss)
-                     + sum(reg_terms.values()))
+    def objective_device(total_scores):
+        """Full regularized objective as a DEVICE scalar — strict mode
+        float()s it immediately, pipelined mode defers the readback to the
+        outer-iteration boundary flush."""
+        return (_data_term(total_scores, base_offsets, labels,
+                           weights, loss=loss)
+                + sum(reg_terms.values()))
 
     # init (reference: CoordinateDescent.run line 57-96); a resume record
     # overrides the initial models and restores histories + best tracking
@@ -357,7 +496,8 @@ def run_coordinate_descent(
                 reg_terms[name] = coordinates[name].regularization_term(
                     provided)
         total = sum(scores.values(), zeros)
-        _sync(total)
+        if not pipelined:
+            spans.add_blocked("init/score", _sync(total))
 
     objective_history: List[float] = list(
         resume.objective_history if resume is not None else [])
@@ -376,6 +516,7 @@ def run_coordinate_descent(
     # changed coordinate is rescored — same algebra as the training side)
     do_validation = validation_dataset is not None and validation_specs
     val_scores_by_coord = {}
+    val_labels_dev = val_weights_dev = val_offsets_dev = None
     if do_validation:
         with spans.span("init/validation_score"):
             val_zeros = jnp.zeros(validation_dataset.num_rows)
@@ -384,53 +525,174 @@ def run_coordinate_descent(
                        if (initial_models or {}).get(name) is None
                        else models[name].score_dataset(validation_dataset))
                 for name in updating_sequence}
-            _sync(*val_scores_by_coord.values())
+            if pipelined:
+                # device copies for the jitted metric kernels (the host
+                # evaluators read the numpy arrays off the dataset instead)
+                val_labels_dev = jnp.asarray(validation_dataset.response)
+                val_weights_dev = (None if validation_dataset.weights is None
+                                   else jnp.asarray(validation_dataset.weights))
+                val_offsets_dev = (None if validation_dataset.offsets is None
+                                   else jnp.asarray(validation_dataset.offsets))
+            else:
+                spans.add_blocked("init/validation_score",
+                                  _sync(*val_scores_by_coord.values()))
 
-    for it in range(start_iteration, num_iterations):
-        for name in updating_sequence:
-            solve_key = f"{it}/{name}/solve"
-            with spans.span(solve_key):
-                coord = coordinates[name]
-                # partial = full - own (reference line 186-193)
-                partial = total - scores[name]
-                models[name], tracker = coord.update(
-                    models[name], base_offsets + partial)
-                scores[name] = coord.score(models[name])
-                total = partial + scores[name]
-                _sync(total)
-            trackers[f"{it}/{name}"] = _summarize_tracker(
-                tracker, spans[solve_key])
+    def evaluate_spec_device(spec: ValidationSpec, val_total):
+        """Device-scalar metric for one spec, or None when the spec has no
+        device path (grouped or custom metrics -> host fallback)."""
+        if spec.group_column is not None:
+            return None
+        device_eval = getattr(spec.evaluator, "evaluate_on_device", None)
+        if device_eval is None:
+            return None
+        s = (val_total if val_offsets_dev is None
+             else val_total + val_offsets_dev)
+        return device_eval(s, val_labels_dev, val_weights_dev)
 
-            with spans.span(f"{it}/{name}/objective"):
-                reg_terms[name] = coord.regularization_term(models[name])
-                obj = training_objective(total)
+    # pipelined mode: per-update records awaiting the boundary readback
+    # (device scalars + a models snapshot for deferred best tracking)
+    pending: List[dict] = []
+
+    def flush_pending() -> None:
+        """ONE batched device_get for every objective + metric scalar of
+        the outer iteration, then the deferred host bookkeeping (history
+        appends, tracker summaries, best-model tracking, logging)."""
+        nonlocal best_metric, best_model
+        if not pending:
+            return
+        fetched = jax.device_get(
+            [[p["objective"], list(p["metrics"].values())] for p in pending])
+        for p, (obj, metric_vals) in zip(pending, fetched):
+            obj = float(obj)
             objective_history.append(obj)
+            trackers[f"{p['it']}/{p['name']}"] = _summarize_tracker(
+                p["tracker"], spans[p["solve_key"]])
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
-                        it, name, obj, spans[solve_key])
+                        p["it"], p["name"], obj, spans[p["solve_key"]])
+            for k, (spec, v) in enumerate(zip(validation_specs, metric_vals)):
+                v = float(v)
+                validation_history[spec.name].append(v)
+                logger.info("  validation %-24s = %.6g", spec.name, v)
+                if k == 0:  # best FULL model by first evaluator (ref 294-335)
+                    if best_metric is None or \
+                            spec.evaluator.better_than(v, best_metric):
+                        best_metric = v
+                        best_model = GameModel(dict(p["models"]), task_type)
+        pending.clear()
 
-            if do_validation:
-                with spans.span(f"{it}/{name}/validation"):
-                    val_scores_by_coord[name] = \
-                        models[name].score_dataset(validation_dataset)
-                    val_scores = sum(val_scores_by_coord.values(),
-                                     jnp.zeros(validation_dataset.num_rows))
-                    vals = [spec.evaluate(validation_dataset, val_scores)
-                            for spec in validation_specs]
-                for k, (spec, v) in enumerate(zip(validation_specs, vals)):
-                    validation_history[spec.name].append(v)
-                    logger.info("  validation %-24s = %.6g", spec.name, v)
-                    if k == 0:  # best FULL model by first evaluator (ref 294-335)
-                        if best_metric is None or spec.evaluator.better_than(v, best_metric):
-                            best_metric = v
-                            best_model = GameModel(dict(models), task_type)
+    checkpointer: Optional[AsyncCheckpointer] = None
+    loop_ok = False
+    try:
+        for it in range(start_iteration, num_iterations):
+            for name in updating_sequence:
+                solve_key = f"{it}/{name}/solve"
+                with spans.span(solve_key):
+                    coord = coordinates[name]
+                    # partial = full - own (reference line 186-193)
+                    partial = total - scores[name]
+                    models[name], tracker = coord.update(
+                        models[name], base_offsets + partial)
+                    scores[name] = coord.score(models[name])
+                    total = partial + scores[name]
+                    if not pipelined:
+                        spans.add_blocked(solve_key, _sync(total))
+                if not pipelined:
+                    # tracker summaries read device iteration counts — a
+                    # per-update sync pipelined mode defers to the flush
+                    trackers[f"{it}/{name}"] = _summarize_tracker(
+                        tracker, spans[solve_key])
 
-        if checkpoint_dir is not None:
-            with spans.span(f"{it}/checkpoint"):
-                _write_checkpoint(checkpoint_dir, it,
-                                  GameModel(dict(models), task_type),
-                                  objective_history, validation_history,
-                                  best_model, best_metric,
-                                  checkpoint_fingerprint)
+                obj_key = f"{it}/{name}/objective"
+                with spans.span(obj_key):
+                    reg_terms[name] = coord.regularization_term(models[name])
+                    obj_dev = objective_device(total)
+                    if not pipelined:
+                        t0 = time.perf_counter()
+                        obj = float(obj_dev)
+                        spans.add_blocked(obj_key, time.perf_counter() - t0)
+                if not pipelined:
+                    objective_history.append(obj)
+                    logger.info("iter %d coordinate %-16s objective=%.8g "
+                                "(%.2fs)", it, name, obj, spans[solve_key])
+
+                metrics: Dict[str, object] = {}
+                if do_validation:
+                    val_key = f"{it}/{name}/validation"
+                    with spans.span(val_key):
+                        val_scores_by_coord[name] = \
+                            models[name].score_dataset(validation_dataset)
+                        val_scores = sum(val_scores_by_coord.values(),
+                                         jnp.zeros(validation_dataset.num_rows))
+                        if pipelined:
+                            for spec in validation_specs:
+                                v = evaluate_spec_device(spec, val_scores)
+                                if v is None:
+                                    # no device kernel (grouped/custom):
+                                    # host fallback, one timed [n] transfer
+                                    t0 = time.perf_counter()
+                                    s_np = np.asarray(val_scores)
+                                    spans.add_blocked(
+                                        val_key, time.perf_counter() - t0)
+                                    v = spec.evaluate(validation_dataset, s_np)
+                                metrics[spec.name] = v
+                        else:
+                            t0 = time.perf_counter()
+                            s_np = np.asarray(val_scores)
+                            spans.add_blocked(val_key,
+                                              time.perf_counter() - t0)
+                            vals = [spec.evaluate(validation_dataset, s_np)
+                                    for spec in validation_specs]
+                    if not pipelined:
+                        for k, (spec, v) in enumerate(
+                                zip(validation_specs, vals)):
+                            validation_history[spec.name].append(v)
+                            logger.info("  validation %-24s = %.6g",
+                                        spec.name, v)
+                            if k == 0:  # best FULL model by first evaluator
+                                if best_metric is None or \
+                                        spec.evaluator.better_than(v, best_metric):
+                                    best_metric = v
+                                    best_model = GameModel(dict(models),
+                                                           task_type)
+                if pipelined:
+                    pending.append({"it": it, "name": name,
+                                    "solve_key": solve_key,
+                                    "objective": obj_dev, "metrics": metrics,
+                                    "models": dict(models),
+                                    "tracker": tracker})
+
+            if pipelined:
+                # outer-iteration boundary: the ONE host sync of the
+                # iteration (Snap ML-style pipelining: everything above was
+                # enqueued without waiting)
+                with spans.span(f"{it}/flush", host_blocked=True):
+                    flush_pending()
+
+            if checkpoint_dir is not None:
+                with spans.span(f"{it}/checkpoint"):
+                    ckpt_model = GameModel(dict(models), task_type)
+                    if pipelined:
+                        if checkpointer is None:
+                            checkpointer = AsyncCheckpointer(checkpoint_dir)
+                        checkpointer.submit(it, ckpt_model,
+                                            objective_history,
+                                            validation_history,
+                                            best_model, best_metric,
+                                            checkpoint_fingerprint)
+                    else:
+                        _write_checkpoint(checkpoint_dir, it, ckpt_model,
+                                          objective_history,
+                                          validation_history,
+                                          best_model, best_metric,
+                                          checkpoint_fingerprint)
+        loop_ok = True
+    finally:
+        if checkpointer is not None:
+            # drain + stop the writer; on the success path a worker failure
+            # must surface (durability is part of the fit's contract), on
+            # an exception path it must not mask the original error
+            with spans.span("checkpoint/join"):
+                checkpointer.shutdown(raise_errors=loop_ok)
 
     if (do_validation and resume is not None
             and start_iteration >= num_iterations

@@ -146,7 +146,13 @@ class FixedEffectCoordinate:
                                    opt.regularization, opt.regularization_weight,
                                    shard_features=self.shard_features)
         else:
-            res = _cached_solver(opt.optimizer, opt.regularization)(
+            if x0 is model.glm.coefficients.means:
+                # the solver donates x0 (in-place buffer reuse); the model's
+                # live coefficients may still be referenced by best-model /
+                # checkpoint snapshots, so donate a copy, never the original
+                x0 = jnp.array(x0, copy=True)
+            res = _cached_solver(opt.optimizer, opt.regularization,
+                                 donate=True)(
                 obj, x0, jnp.asarray(opt.regularization_weight, self.x.dtype))
         c = res.x
         if self.norm is not None:
@@ -248,17 +254,29 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
         """reference: RandomEffectCoordinate.updateModel — the 3-way join +
         per-entity local solves become one gather + one batched solve per
         S-bucket (each size class runs its own compiled program; lanes are
-        contiguous so results concatenate straight back into [E, d])."""
+        contiguous so results concatenate straight back into [E, d]).
+
+        EVERY bucket's solve is dispatched before any result is touched —
+        the concatenate below consumes nothing until all size classes are
+        in the device queue, so the accelerator never drains between
+        buckets.  Each bucket's x0 slice is donated to its solve for
+        in-place buffer reuse."""
         opt = self.config.optimization
         results = []
         for bucket in self.red.buckets:
             blocks = bucket.with_offsets_from_flat(offsets)
             lo = bucket.lane_start
+            x0 = model.coefficients[lo: lo + bucket.num_entities]
+            if x0 is model.coefficients:
+                # a full-extent slice is returned as-is by jnp (single
+                # bucket spanning every lane): donating it would consume
+                # the model's live buffer, still referenced by best-model /
+                # checkpoint snapshots — donate a copy instead
+                x0 = jnp.array(x0, copy=True)
             res_b = fit_random_effects(
-                blocks, self.loss, self.mesh,
-                x0=model.coefficients[lo: lo + bucket.num_entities],
+                blocks, self.loss, self.mesh, x0=x0,
                 config=opt.optimizer, reg=opt.regularization,
-                reg_weight=opt.regularization_weight)
+                reg_weight=opt.regularization_weight, donate_buffers=True)
             results.append(res_b)
         res = (results[0] if len(results) == 1 else jax.tree_util.tree_map(
             lambda *a: jnp.concatenate(a, axis=0), *results))

@@ -146,8 +146,15 @@ class GameEstimator:
         evaluator_specs: Optional[Sequence[str]] = None,
         initial_model: Optional[GameModel] = None,
         checkpoint_dir: Optional[str] = None,
+        timing_mode: str = "pipelined",
     ) -> GameResult:
         """reference: GameEstimator.fit (GameEstimator.scala:175).
+
+        `timing_mode="pipelined"` (default) overlaps host bookkeeping with
+        device solves: objectives/metrics are fetched in one batched
+        readback per outer iteration and checkpoints serialize on a
+        background thread.  `"strict"` syncs after every coordinate update
+        — same math bit-for-bit, attributable PhaseTimings spans.
 
         `initial_model` warm-starts every coordinate it covers (reference:
         GameTrainingParams.useWarmStart — "the previous optimal model is used
@@ -181,7 +188,8 @@ class GameEstimator:
             validation_dataset=validation_dataset, validation_specs=specs,
             initial_models=initial_models,
             checkpoint_dir=checkpoint_dir, resume=resume,
-            checkpoint_fingerprint=fingerprint, timings=spans)
+            checkpoint_fingerprint=fingerprint, timings=spans,
+            timing_mode=timing_mode)
         validation = {name: hist[-1] for name, hist in
                       descent.validation_history.items() if hist}
         if self.emitter is not None:
@@ -206,6 +214,7 @@ class GameEstimator:
         warm_start: bool = False,
         checkpoint_dir: Optional[str] = None,
         initial_model: Optional[GameModel] = None,
+        timing_mode: str = "pipelined",
     ) -> List[GameResult]:
         """Sweep per-coordinate optimization configs (cartesian product),
         reference: GameTrainingParams.getAllModelConfigs + train-per-config
@@ -237,7 +246,7 @@ class GameEstimator:
             results.append(sub.fit(
                 dataset, validation_dataset, evaluator_specs,
                 initial_model=previous if warm_start else initial_model,
-                checkpoint_dir=combo_ckpt))
+                checkpoint_dir=combo_ckpt, timing_mode=timing_mode))
             previous = results[-1].model
         return results
 

@@ -16,6 +16,7 @@ the way out (reference: GeneralizedLinearOptimizationProblem.createModel).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Optional, Sequence
 
@@ -77,7 +78,10 @@ def train_glm(
     objective = GLMObjective(loss, x, labels, weights=weights, offsets=offsets,
                              norm=normalization)
 
-    @jax.jit
+    # x0 is donated (reused in place for the solution): every start point
+    # below is a buffer this function owns — fresh zeros, a copy of the
+    # caller's initial model, or a copy at the warm-start handoff
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def _solve(x0: jax.Array, lam: jax.Array) -> SolveResult:
         return solve(objective, x0, optimizer_config, regularization, lam)
 
@@ -92,6 +96,10 @@ def train_glm(
         x0 = initial_model.coefficients.means.astype(dtype)
         if normalization is not None:
             x0 = normalization.model_to_transformed_space(x0)
+        if x0 is initial_model.coefficients.means:
+            # same-dtype astype is a no-op: donating would consume the
+            # caller's model coefficients
+            x0 = jnp.array(x0, copy=True)
     else:
         x0 = jnp.zeros((d,), dtype)
 
@@ -100,7 +108,10 @@ def train_glm(
     # least constrained problem (reference: ModelTraining.scala sorted sweep)
     for lam in sorted(regularization_weights, reverse=True):
         t0 = time.perf_counter()
-        res = _solve(x0, jnp.asarray(lam, dtype))
+        # without warm start the SAME x0 seeds every lambda: donate a copy
+        # so the shared start point survives the sweep
+        res = _solve(x0 if warm_start else jnp.array(x0, copy=True),
+                     jnp.asarray(lam, dtype))
         float(res.value)  # device->host readback: a true sync even where
         # block_until_ready returns early (tunneled accelerator)
         wall_s = time.perf_counter() - t0
@@ -116,7 +127,9 @@ def train_glm(
         out.append(TrainedModel(float(lam), model_for_task(task_type, coeffs),
                                 res, wall_s=wall_s))
         if warm_start:
-            x0 = c_norm
+            # c_norm is res.x, kept alive inside the returned TrainedModel;
+            # the next solve donates its x0, so hand it a copy
+            x0 = jnp.array(c_norm, copy=True)
     return out
 
 

@@ -80,11 +80,18 @@ def shard_objective(objective: GLMObjective, mesh: Mesh) -> GLMObjective:
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_solver(config: OptimizerConfig, reg: RegularizationContext):
+def _cached_solver(config: OptimizerConfig, reg: RegularizationContext,
+                   donate: bool = False):
     """One persistent jit wrapper per (config, reg): repeated calls — e.g.
     every coordinate-descent outer iteration — reuse the XLA executable
-    (loss/shape/sharding changes are handled by jit's own pytree cache)."""
-    return jax.jit(lambda obj, x0, lam: solve(obj, x0, config, reg, lam))
+    (loss/shape/sharding changes are handled by jit's own pytree cache).
+
+    `donate=True` donates x0 so the solution can reuse its buffer in
+    place.  The donated x0 is CONSUMED — callers must pass a buffer
+    nothing else references (FixedEffectCoordinate.update copy-guards the
+    live model coefficients before donating)."""
+    return jax.jit(lambda obj, x0, lam: solve(obj, x0, config, reg, lam),
+                   donate_argnums=(1,) if donate else ())
 
 
 def fit_fixed_effect(

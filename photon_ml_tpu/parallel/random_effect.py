@@ -84,9 +84,16 @@ class EntityBlocks:
 @functools.lru_cache(maxsize=64)
 def _cached_batched_solver(loss: PointwiseLoss, config: OptimizerConfig,
                            reg: RegularizationContext, has_weights: bool,
-                           has_offsets: bool):
+                           has_offsets: bool, donate: bool = False):
     """Persistent jit-of-vmap per static signature: coordinate-descent
-    iterations reuse the compiled batched solve instead of retracing."""
+    iterations reuse the compiled batched solve instead of retracing.
+
+    `donate=True` donates x0 [E, d] so the solution aliases its buffer in
+    place instead of allocating a fresh coefficient block every coordinate
+    update (offsets/feature blocks have no same-shaped output to alias, so
+    donating them would free nothing and warn).  A donated x0 is CONSUMED:
+    callers must pass a buffer nothing else references (see
+    fit_random_effects/donate_buffers)."""
 
     def solve_one(x, labels, mask, weights, offsets, x0_e, lam):
         obj = GLMObjective(loss, x, labels, weights=weights, offsets=offsets,
@@ -95,7 +102,8 @@ def _cached_batched_solver(loss: PointwiseLoss, config: OptimizerConfig,
 
     return jax.jit(jax.vmap(solve_one,
                             in_axes=(0, 0, 0, 0 if has_weights else None,
-                                     0 if has_offsets else None, 0, None)))
+                                     0 if has_offsets else None, 0, None)),
+                   donate_argnums=(5,) if donate else ())
 
 
 def fit_random_effects(
@@ -106,6 +114,7 @@ def fit_random_effects(
     config: OptimizerConfig = OptimizerConfig(),
     reg: RegularizationContext = RegularizationContext(),
     reg_weight: jax.Array | float = 0.0,
+    donate_buffers: bool = False,
 ) -> SolveResult:
     """All per-entity solves as one batched program.
 
@@ -115,6 +124,13 @@ def fit_random_effects(
     (RandomEffectCoordinate.scala:96-110); the regularization-weight plumbing
     matches RandomEffectOptimizationProblem (one lambda shared by all
     entities).
+
+    `donate_buffers=True` donates `x0` to the solve: the buffer is
+    CONSUMED (reading it afterwards raises) and the solution reuses it in
+    place.  Only pass it when x0 is not referenced elsewhere — the
+    coordinate-descent update path qualifies because it copy-guards x0.
+    Ignored on the mesh path (device_put can alias its input, so donation
+    there could consume a caller-held array).
     """
     E, S, d = blocks.x.shape
     dtype = blocks.x.dtype
@@ -139,7 +155,8 @@ def fit_random_effects(
 
     batched = _cached_batched_solver(loss, config, reg,
                                      blocks.weights is not None,
-                                     blocks.offsets is not None)
+                                     blocks.offsets is not None,
+                                     donate=donate_buffers and mesh is None)
     if mesh is None:
         return batched(blocks.x, blocks.labels, blocks.mask,
                        blocks.weights, blocks.offsets, x0, lam)
@@ -216,6 +233,12 @@ def score_by_entity(coefficients: jax.Array, x: jax.Array,
     reference's missing-score default (Evaluator.scala:35-45).
     """
     num_entities = coefficients.shape[0]
+    if num_entities == 0:
+        # empty coefficient table (every entity of this type fell below
+        # passive_data_lower_bound): all rows are unseen and score 0.  The
+        # general path would clip indices to -1 and gather from a
+        # zero-length axis — garbage, not zeros.
+        return jnp.zeros(x.shape[0], x.dtype)
     in_range = (entity_index >= 0) & (entity_index < num_entities)
     safe_idx = jnp.clip(entity_index, 0, num_entities - 1)
     w = coefficients[safe_idx]                      # [n, d] gather

@@ -1,0 +1,55 @@
+"""bench.py --smoke end-to-end in the tier-1 suite (ISSUE 2 satellite):
+bench-harness regressions (broken entry plumbing, pipeline parity drift)
+surface in the normal test run instead of only at bench time.
+"""
+import importlib.util
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_smoke_under_test", os.path.join(_REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_smoke_end_to_end(tmp_path):
+    bench = _load_bench()
+    out = tmp_path / "BENCH_smoke.json"
+    result = bench.smoke_bench(str(out))
+
+    # the kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    on_disk = json.loads(out.read_text())
+    assert on_disk == json.loads(json.dumps(result))
+
+    glm = result["detail"]["glm"]
+    assert glm["final_value_finite"] is True
+    assert glm["n"] > 0 and glm["d"] > 0 and glm["wall_s"] > 0
+
+    game = result["detail"]["game_pipeline"]
+    # the strict-vs-pipelined smoke pair is a REAL parity gate: identical
+    # objective histories (1e-9) and bit-identical final model directories
+    assert game["parity_ok"] is True
+    assert game["objective_history_max_abs_gap"] <= 1e-9
+    assert game["final_model_bit_identical"] is True
+    for mode in ("strict", "pipelined"):
+        stats = game[mode]
+        assert stats["fit_s"] > 0
+        assert 0.0 <= stats["host_blocked_frac"] <= 1.0
+
+
+def test_bench_smoke_writes_no_repo_state(tmp_path, monkeypatch):
+    """Smoke mode must not touch the committed bench caches (it is run by
+    the tier-1 suite, which may not write repo files)."""
+    bench = _load_bench()
+    before = os.path.getmtime(os.path.join(_REPO, "bench_ref_cache.json"))
+    monkeypatch.chdir(tmp_path)
+    bench.smoke_bench(str(tmp_path / "s.json"))
+    assert os.path.getmtime(
+        os.path.join(_REPO, "bench_ref_cache.json")) == before
+    assert not os.path.exists(os.path.join(_REPO, "BENCH_smoke.json"))

@@ -201,3 +201,47 @@ def test_make_mesh_device_subsets():
     assert list(m.devices.ravel()) == devs[:4]
     with pytest.raises(ValueError, match="mesh"):
         make_mesh(num_data=3, num_feature=2, devices=devs[:4])
+
+
+def test_score_by_entity_empty_coefficient_table(rng):
+    """Satellite bugfix (ISSUE 2): num_entities == 0 (every entity of a
+    type below passive_data_lower_bound) must score every row 0 — the
+    general path clips indices to -1 and gathers from a zero-length axis."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.parallel.random_effect import score_by_entity
+    x = jnp.asarray(rng.normal(size=(7, 3)).astype(np.float32))
+    empty = jnp.zeros((0, 3), jnp.float32)
+    idx = jnp.asarray(np.full(7, -1, np.int32))
+    s = np.asarray(score_by_entity(empty, x, idx))
+    assert s.shape == (7,)
+    assert (s == 0.0).all()
+    # and it stays jittable with a zero-length entity axis
+    jitted = jax.jit(score_by_entity)
+    s2 = np.asarray(jitted(empty, x, idx))
+    assert (s2 == 0.0).all()
+
+
+def test_fit_random_effects_donated_x0_consumed(rng):
+    """donate_buffers=True consumes x0 (in-place reuse): reading the
+    donated buffer afterwards raises, and the solve result is identical to
+    the non-donating path."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.optim import RegularizationContext, RegularizationType
+    from photon_ml_tpu.parallel import EntityBlocks, fit_random_effects
+    E, S, d = 6, 4, 3
+    x = jnp.asarray(rng.normal(size=(E, S, d)).astype(np.float32))
+    labels = jnp.asarray((rng.uniform(size=(E, S)) > 0.5).astype(np.float32))
+    mask = jnp.ones((E, S), jnp.float32)
+    blocks = EntityBlocks(x, labels, mask)
+    reg = RegularizationContext(RegularizationType.L2)
+    ref = fit_random_effects(blocks, LOGISTIC, reg=reg, reg_weight=1.0)
+    x0 = jnp.zeros((E, d), jnp.float32)
+    res = fit_random_effects(blocks, LOGISTIC, x0=x0, reg=reg,
+                             reg_weight=1.0, donate_buffers=True)
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(ref.x),
+                               rtol=1e-6)
+    with pytest.raises((RuntimeError, ValueError)):
+        np.asarray(x0)  # donated: the buffer is gone

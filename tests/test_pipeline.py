@@ -1,0 +1,284 @@
+"""Pipelined coordinate descent: strict-vs-pipelined parity, compile-count
+regression, async checkpointer semantics, host-blocked accounting.
+
+The pipelining contract (ISSUE 2): timing_mode changes WHEN the host reads
+device results and writes checkpoints — never WHAT is computed.  Strict and
+pipelined fits must therefore agree bit-for-bit on objective history and
+final coefficients, including across a checkpoint/resume boundary with the
+async checkpointer, and the jit caches must stop growing after the first
+outer iteration.
+"""
+import glob
+import json
+import logging
+import os
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import build_game_dataset
+from photon_ml_tpu.game import (
+    FixedEffectCoordinateConfig, GameEstimator, GameTrainingConfig,
+    GLMOptimizationConfig, RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.game.coordinate_descent import (
+    AsyncCheckpointer, PhaseTimings, read_checkpoint,
+)
+from photon_ml_tpu.models.io import save_game_model
+from photon_ml_tpu.optim import RegularizationContext, RegularizationType
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+def _glmix(rng, n=1000, d_global=6, num_users=25, d_user=3):
+    xg = rng.normal(size=(n, d_global)); xg[:, -1] = 1.0
+    xu = rng.normal(size=(n, d_user)); xu[:, -1] = 1.0
+    users = rng.integers(0, num_users, size=n)
+    z = xg @ rng.normal(size=d_global) + np.einsum(
+        "nd,nd->n", xu, rng.normal(size=(num_users, d_user))[users])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(float)
+    ids = np.asarray([f"u{u:03d}" for u in users])
+    ds = build_game_dataset(y, {"global": xg, "per_user": xu},
+                            entity_ids={"userId": ids})
+    rows = np.arange(n)
+    return ds.subset(rows[:800]), ds.subset(rows[800:])
+
+
+def _config(iters=2):
+    return GameTrainingConfig(
+        task_type="logistic_regression",
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                "global", GLMOptimizationConfig(
+                    regularization=L2, regularization_weight=0.1)),
+            "perUser": RandomEffectCoordinateConfig(
+                "userId", "per_user", GLMOptimizationConfig(
+                    regularization=L2, regularization_weight=1.0)),
+        },
+        updating_sequence=["fixed", "perUser"],
+        num_outer_iterations=iters)
+
+
+def _model_dir_arrays(directory):
+    """{relative npz path: {key: array}} for bit-exact comparison."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(directory, "**", "*.npz"),
+                                 recursive=True)):
+        with np.load(path, allow_pickle=True) as z:
+            out[os.path.relpath(path, directory)] = {k: z[k] for k in z.files}
+    return out
+
+
+def _assert_model_dirs_bit_identical(dir_a, dir_b):
+    a, b = _model_dir_arrays(dir_a), _model_dir_arrays(dir_b)
+    assert sorted(a) == sorted(b)
+    for rel in a:
+        assert sorted(a[rel]) == sorted(b[rel]), rel
+        for k in a[rel]:
+            va, vb = a[rel][k], b[rel][k]
+            if va.dtype == object:
+                assert np.array_equal(va, vb), (rel, k)
+            else:
+                assert va.tobytes() == vb.tobytes(), (rel, k)
+
+
+def test_strict_pipelined_parity(rng, tmp_path):
+    """Identical objective history (1e-9, in practice exact) and
+    bit-identical saved model directories across timing modes."""
+    train, val = _glmix(rng)
+    results = {}
+    for mode in ("strict", "pipelined"):
+        results[mode] = GameEstimator(_config(iters=3)).fit(
+            train, val, checkpoint_dir=str(tmp_path / f"ckpt-{mode}"),
+            timing_mode=mode)
+    s, p = results["strict"], results["pipelined"]
+    assert len(s.objective_history) == len(p.objective_history) == 6
+    np.testing.assert_allclose(p.objective_history, s.objective_history,
+                               rtol=0, atol=1e-9)
+    for tag, (ma, mb) in (("final", (s.descent.model, p.descent.model)),
+                          ("best", (s.model, p.model))):
+        da, db = tmp_path / f"{tag}-s", tmp_path / f"{tag}-p"
+        save_game_model(ma, str(da))
+        save_game_model(mb, str(db))
+        _assert_model_dirs_bit_identical(str(da), str(db))
+    # both modes tracked validation for every update
+    for name, hist in s.descent.validation_history.items():
+        assert len(p.descent.validation_history[name]) == len(hist)
+        np.testing.assert_allclose(p.descent.validation_history[name], hist,
+                                   rtol=1e-6)
+
+
+def test_resume_parity_with_async_checkpointer(rng, tmp_path):
+    """A pipelined fit interrupted after one outer iteration and resumed
+    (async checkpointer on both legs) matches the straight strict run to
+    1e-9 — histories are continuous across the checkpoint boundary and the
+    final coefficients are bit-identical."""
+    train, val = _glmix(rng)
+    straight = GameEstimator(_config(iters=3)).fit(
+        train, val, timing_mode="strict")
+
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator(_config(iters=1)).fit(train, val, checkpoint_dir=ckpt,
+                                        timing_mode="pipelined")
+    state = read_checkpoint(ckpt)
+    assert state is not None and state.completed_iterations == 1
+    resumed = GameEstimator(_config(iters=3)).fit(
+        train, val, checkpoint_dir=ckpt, timing_mode="pipelined")
+    assert len(resumed.objective_history) == len(straight.objective_history)
+    np.testing.assert_allclose(resumed.objective_history,
+                               straight.objective_history, rtol=0, atol=1e-9)
+    da, db = tmp_path / "final-straight", tmp_path / "final-resumed"
+    save_game_model(straight.descent.model, str(da))
+    save_game_model(resumed.descent.model, str(db))
+    _assert_model_dirs_bit_identical(str(da), str(db))
+
+
+def test_pipelined_checkpoint_durable_after_fit(rng, tmp_path):
+    """AsyncCheckpointer durability contract: after fit() returns, the
+    LAST outer iteration's record is on disk and resumable."""
+    train, val = _glmix(rng, n=600)
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator(_config(iters=2)).fit(train, val, checkpoint_dir=ckpt,
+                                        timing_mode="pipelined")
+    with open(os.path.join(ckpt, "state.json")) as f:
+        state = json.load(f)
+    assert state["completed_iterations"] == 2
+    assert os.path.isdir(state["model_dir"])
+    # replay is a no-op: the checkpoint already covers every iteration
+    replay = GameEstimator(_config(iters=2)).fit(
+        train, val, checkpoint_dir=ckpt, timing_mode="pipelined")
+    assert replay.descent.total_iterations() == 0
+
+
+class _CompileCounter(logging.Handler):
+    """Counts XLA compile events via jax_log_compiles (each 'Compiling
+    <name> with global shapes' record is one fresh trace+compile)."""
+
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def emit(self, record):
+        if record.getMessage().startswith("Compiling "):
+            self.count += 1
+
+
+class _compile_counting:
+    def __enter__(self):
+        import jax
+        self._jax = jax
+        self.handler = _CompileCounter()
+        self.logger = logging.getLogger("jax._src.interpreters.pxla")
+        self._level = self.logger.level
+        self.logger.addHandler(self.handler)
+        self.logger.setLevel(logging.WARNING)
+        jax.config.update("jax_log_compiles", True)
+        return self.handler
+
+    def __exit__(self, *exc):
+        self._jax.config.update("jax_log_compiles", False)
+        self.logger.removeHandler(self.handler)
+        self.logger.setLevel(self._level)
+
+
+def test_zero_new_traces_after_first_outer_iteration(rng):
+    """Compile-count regression (ISSUE 2 satellite): once the first outer
+    iteration of a 2-coordinate GAME fit has traced everything, later
+    iterations, repeat fits of the same shapes, AND grid-sweep combos that
+    only change regularization weights must hit the persistent caches
+    (_cached_batched_solver / _cached_solver / module-level jits) without
+    a single new trace."""
+    train, val = _glmix(rng)
+    # warm every program: compiles happen here (count unchecked)
+    GameEstimator(_config(iters=1)).fit(train, val)
+
+    with _compile_counting() as counter:
+        GameEstimator(_config(iters=3)).fit(train, val)
+    assert counter.count == 0, (
+        f"{counter.count} fresh XLA compiles after the warmup fit — a "
+        "per-fit closure or unstable jit cache key crept into the loop")
+
+    # same shapes, different lambdas: the grid sweep must reuse every trace
+    grid = {"perUser": [
+        GLMOptimizationConfig(regularization=L2, regularization_weight=w)
+        for w in (10.0, 0.1)]}
+    with _compile_counting() as counter:
+        GameEstimator(_config(iters=1)).fit_grid(train, grid, val)
+    assert counter.count == 0, (
+        f"{counter.count} fresh XLA compiles across grid combos of "
+        "identical shapes — regularization weight leaked into a static "
+        "cache key")
+
+
+def test_async_checkpointer_coalesces_and_drains(tmp_path, monkeypatch):
+    """Keep-latest semantics: snapshots superseded before their write
+    starts are dropped; the final snapshot always lands; written +
+    coalesced accounts for every submission."""
+    from photon_ml_tpu.game import coordinate_descent as cd
+
+    written = []
+
+    def slow_write(directory, iteration, *rest):
+        time.sleep(0.05)
+        written.append(iteration)
+
+    monkeypatch.setattr(cd, "_write_checkpoint", slow_write)
+    ckpt = cd.AsyncCheckpointer(str(tmp_path))
+    n = 8
+    for it in range(n):
+        ckpt.submit(it, None, [], {}, None, None, None)
+    ckpt.shutdown()
+    assert written[-1] == n - 1          # the newest record always lands
+    assert written == sorted(written)    # submission order preserved
+    assert ckpt.written == len(written)
+    assert ckpt.written + ckpt.coalesced == n
+    assert ckpt.coalesced > 0            # a 50ms writer must coalesce
+
+
+def test_async_checkpointer_error_surfaces(tmp_path, monkeypatch):
+    from photon_ml_tpu.game import coordinate_descent as cd
+
+    def failing_write(directory, *rest):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(cd, "_write_checkpoint", failing_write)
+    ckpt = cd.AsyncCheckpointer(str(tmp_path))
+    ckpt.submit(0, None, [], {}, None, None, None)
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        # the failure surfaces at the next submit or at shutdown
+        for _ in range(50):
+            time.sleep(0.01)
+            ckpt.submit(1, None, [], {}, None, None, None)
+        ckpt.shutdown()
+
+
+def test_timing_mode_validated(rng):
+    train, val = _glmix(rng, n=400)
+    with pytest.raises(ValueError, match="timing_mode"):
+        GameEstimator(_config(iters=1)).fit(train, val,
+                                            timing_mode="eventually")
+
+
+def test_host_blocked_accounting(rng, tmp_path):
+    """Strict mode attributes its per-update syncs/readbacks as
+    host-blocked; pipelined mode concentrates them in the boundary flush
+    and a PhaseTimings copy keeps plain-dict compatibility."""
+    train, val = _glmix(rng)
+    strict = GameEstimator(_config(iters=2)).fit(train, val,
+                                                 timing_mode="strict")
+    sp = strict.descent.timings
+    assert isinstance(sp, PhaseTimings)
+    assert sp.host_blocked_total() > 0
+    # strict blocked spans sit inside solve/objective/validation spans
+    assert any(k.endswith("/objective") for k in sp.host_blocked)
+
+    piped = GameEstimator(_config(iters=2)).fit(
+        train, val, checkpoint_dir=str(tmp_path / "ckpt"),
+        timing_mode="pipelined")
+    pp = piped.descent.timings
+    assert any(k.endswith("/flush") for k in pp)
+    assert "checkpoint/join" in pp
+    # every pipelined blocked second is attributed to a span
+    for label in pp.host_blocked:
+        assert label in pp
