@@ -158,6 +158,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "record on restart; sweeps checkpoint per grid "
                         "combo (the reference restarts failed jobs from "
                         "scratch)")
+    p.add_argument("--hbm-budget", default=None,
+                   help="device-memory residency budget, e.g. '8GB', "
+                        "'512MB', or raw bytes.  When the training "
+                        "coordinates' device blocks can't all fit: "
+                        "fixed-effect shards over budget stream in double-"
+                        "buffered host->device chunks, and inactive "
+                        "coordinates' blocks are evicted between "
+                        "coordinate-descent visits (out-of-core training — "
+                        "fit size bounded by host memory, not HBM; see "
+                        "COMPONENTS.md 'Memory modes').  Overrides the "
+                        "config file's hbm_budget_bytes")
     p.add_argument("--timing-mode", default="pipelined",
                    choices=["pipelined", "strict"],
                    help="pipelined (default): device work for the next "
@@ -202,6 +213,30 @@ def resolve_avro_paths(path: str):
     if path.endswith(".avro"):
         return [path]
     return None
+
+
+def parse_byte_size(arg) -> int:
+    """'8GB' / '512MB' / '1.5g' / '4096' -> bytes (decimal units, like
+    accelerator spec sheets)."""
+    if arg is None:
+        return None
+    s = str(arg).strip().lower()
+    units = {"tb": 1e12, "t": 1e12, "gb": 1e9, "g": 1e9, "mb": 1e6,
+             "m": 1e6, "kb": 1e3, "k": 1e3, "b": 1.0}
+    for suffix, mult in units.items():
+        if s.endswith(suffix):
+            num = s[: -len(suffix)].strip()
+            break
+    else:
+        num, mult = s, 1.0
+    try:
+        value = float(num) * mult
+    except ValueError:
+        raise SystemExit(f"--hbm-budget: cannot parse {arg!r} (expected "
+                         "e.g. '8GB', '512MB', or raw bytes)")
+    if value <= 0:
+        raise SystemExit(f"--hbm-budget must be positive, got {arg!r}")
+    return int(value)
 
 
 def _load_json_arg(arg: str):
@@ -520,9 +555,13 @@ def _run(args, log) -> int:
                 raise SystemExit(f"--initial-model-dir: {e}")
             log.info("warm-starting from %s (%s)", args.initial_model_dir,
                      list(initial_model.coordinates))
+        hbm_budget = parse_byte_size(args.hbm_budget)
         if args.config:
+            import dataclasses as _dc
             with open(args.config) as f:
                 config = GameTrainingConfig.from_json(f.read())
+            if hbm_budget is not None:
+                config = _dc.replace(config, hbm_budget_bytes=hbm_budget)
             results = [GameEstimator(config, mesh=mesh, emitter=emitter).fit(
                 train, val, evaluator_specs,
                 initial_model=initial_model,
@@ -546,7 +585,8 @@ def _run(args, log) -> int:
                 coordinates={"fixed": FixedEffectCoordinateConfig(
                     "global", GLMOptimizationConfig(optimizer=opt, regularization=reg),
                     normalization=NormalizationType(args.normalization))},
-                updating_sequence=["fixed"])
+                updating_sequence=["fixed"],
+                hbm_budget_bytes=hbm_budget)
             results = GameEstimator(config, mesh=mesh, emitter=emitter).fit_grid(
                 train, grid, val, evaluator_specs, warm_start=args.warm_start,
                 checkpoint_dir=args.checkpoint_dir,
@@ -593,6 +633,9 @@ def _run(args, log) -> int:
             "validation": best.validation,
             "wall_s": round(time.time() - t0, 2),
             "timing_mode": args.timing_mode,
+            # HBM residency accounting (None budget = unbounded/resident)
+            "hbm_budget_bytes": hbm_budget,
+            "hbm_residency": getattr(best, "residency", None),
             "host_blocked_s": round(
                 getattr(getattr(best.descent, "timings", None),
                         "host_blocked_total", lambda: 0.0)(), 3),

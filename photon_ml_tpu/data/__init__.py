@@ -17,6 +17,9 @@ from photon_ml_tpu.data.samplers import (  # noqa: F401
     binary_classification_downsample, default_downsample, downsampler_for_task,
 )
 from photon_ml_tpu.data.stats import BasicStatisticalSummary  # noqa: F401
+from photon_ml_tpu.data.streaming import (  # noqa: F401
+    ChunkPlan, ChunkSpec, Prefetcher, StreamStats,
+)
 from photon_ml_tpu.data.validators import (  # noqa: F401
     DataValidationError, DataValidationType, validate_game_dataset,
 )

@@ -35,6 +35,23 @@ def _is_sparse(x) -> bool:
         return False
 
 
+@dataclasses.dataclass(frozen=True)
+class ReleasedHostShard:
+    """Placeholder left in GameDataset.feature_shards after
+    release_host_shard: keeps the shape/dtype metadata (shard_dim, byte
+    accounting) while making accidental array reads fail loudly instead of
+    silently operating on stale data."""
+
+    shape: tuple
+    dtype: np.dtype
+    nbytes: int
+
+    def __array__(self, *a, **kw):
+        raise ValueError("this host shard was released "
+                         "(GameDataset.release_host_shard); only the device "
+                         "copy survives")
+
+
 @dataclasses.dataclass
 class InputColumnNames:
     """Remappable input column names (reference: InputColumnsNames.scala)."""
@@ -81,14 +98,52 @@ class GameDataset:
     _scoring_cache: Dict[object, object] = dataclasses.field(
         default_factory=dict, repr=False, compare=False)
 
-    def device_shard(self, shard: str):
+    def device_shard(self, shard: str, *, release_host: bool = False):
         """Device FeatureMatrix view of a shard (dense -> jnp array, scipy
-        sparse -> PaddedSparse), built once and shared."""
+        sparse -> PaddedSparse), built once and shared.
+
+        NOTE the memory doubling: the host numpy shard and the device copy
+        both stay alive for the whole fit (every byte of feature data
+        exists twice).  `release_host=True` drops the host copy once the
+        device copy exists — safe ONLY when nothing will re-read the host
+        array (no out-of-core re-streaming, no dataset.subset, no stats);
+        resident single-fit jobs qualify.  Streaming mode does the inverse
+        (release_device_shard): chunks stage from the host copy and a full
+        device copy would defeat the HBM budget."""
         if shard not in self._device_shards:
             from photon_ml_tpu.ops.features import as_feature_matrix
-            self._device_shards[shard] = as_feature_matrix(
-                self.feature_shards[shard])
+            host = self.feature_shards[shard]
+            if isinstance(host, ReleasedHostShard):
+                raise ValueError(
+                    f"host shard {shard!r} was released (release_host_shard) "
+                    "and no device copy survives; rebuild the dataset")
+            self._device_shards[shard] = as_feature_matrix(host)
+        if release_host:
+            self.release_host_shard(shard)
         return self._device_shards[shard]
+
+    def release_host_shard(self, shard: str) -> None:
+        """Drop the host numpy copy of a shard, keeping only the device
+        copy (halves the footprint of `device_shard`'s doubling).  The slot
+        keeps a shape/dtype placeholder so shard_dim etc. still answer;
+        array reads raise via device_shard's guard."""
+        host = self.feature_shards.get(shard)
+        if host is None or isinstance(host, ReleasedHostShard):
+            return
+        if shard not in self._device_shards:
+            raise ValueError(f"no device copy of shard {shard!r} exists yet; "
+                             "releasing the host copy would lose the data")
+        self.feature_shards[shard] = ReleasedHostShard(
+            shape=tuple(host.shape), dtype=np.dtype(getattr(host, "dtype",
+                                                            np.float64)),
+            nbytes=int(getattr(host, "nbytes", 0) or
+                       getattr(host, "data", np.empty(0)).nbytes))
+
+    def release_device_shard(self, shard: str) -> None:
+        """Drop the shared device copy of a shard (the host copy remains
+        the source of truth).  Used by streaming mode's staging path and by
+        the coordinate residency manager's eviction rotation."""
+        self._device_shards.pop(shard, None)
 
     @property
     def num_rows(self) -> int:

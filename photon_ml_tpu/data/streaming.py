@@ -1,0 +1,269 @@
+"""Out-of-core chunk streaming: host shards -> double-buffered device chunks.
+
+The resident training path requires every coordinate's data on the
+accelerator for the whole fit; bench config 5 documents that 5M MovieLens
+rows exhaust HBM with all four coordinates resident.  Snap ML
+(arXiv:1803.06333) and "Large-Scale Stochastic Learning using GPUs"
+(arXiv:1702.07005) both recover near-resident throughput on datasets larger
+than device memory with hierarchical memory management + pipelined
+host<->accelerator chunk transfer.  This module is that layer:
+
+  - `ChunkPlan` row-partitions a flat batch into power-of-two-sized chunks
+    (via the ONE shape-bucketing rule, utils.math.ceil_pow2, shared with
+    training prep and the serving micro-batcher) so the whole stream
+    compiles at most two XLA programs: the full-chunk shape and the
+    pow-2-padded tail shape.
+  - `Prefetcher` double-buffers: a background thread stages chunk i+1
+    (slice + pad + device transfer) while chunk i computes, with bounded
+    lookahead so at most `depth` (default 2) chunks are device-resident.
+  - `StreamStats` is the transfer-size accounting used where
+    device.memory_stats() is unavailable (CPU tests, tunneled devices):
+    peak resident chunk count/bytes and total bytes staged.
+
+Nothing here is jax-traced: chunk STAGING is host work by design, and every
+compiled consumer (ops/chunked.py) is keyed only on the chunk shape — chunk
+COUNT never appears in a cache key, so growing the dataset re-uses every
+program (tested by tests/test_streaming.py's compile-count regression).
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.utils.math import ceil_pow2
+
+# never plan chunks smaller than this: per-chunk dispatch overhead would
+# dominate (over a tunneled device each program dispatch costs ~the floor
+# bench.py measures via measure_dispatch_floor)
+MIN_CHUNK_ROWS = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkSpec:
+    """One row range [start, stop) padded to `padded_rows` (a power of two).
+    Padding rows carry zero features / SAFE labels / zero weights and are
+    excluded by the chunk mask."""
+
+    index: int
+    start: int
+    stop: int
+    padded_rows: int
+
+    @property
+    def rows(self) -> int:
+        return self.stop - self.start
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkPlan:
+    """Static row partition of an [n, ...] batch into pow-2-sized chunks.
+
+    All full chunks share one shape; the tail is padded to its own power of
+    two, so a plan compiles at most TWO programs per consumer kernel
+    regardless of how many chunks (i.e. how many rows) it covers."""
+
+    num_rows: int
+    chunk_rows: int                  # pow2 size of the full chunks
+    chunks: Tuple[ChunkSpec, ...]
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.chunks)
+
+    @property
+    def chunk_shapes(self) -> Tuple[int, ...]:
+        """Distinct padded sizes, ascending (<= 2 by construction)."""
+        return tuple(sorted({c.padded_rows for c in self.chunks}))
+
+    def chunk_bytes(self, bytes_per_row: int) -> int:
+        """Device bytes of ONE full chunk (the double-buffer unit)."""
+        return self.chunk_rows * bytes_per_row
+
+    @staticmethod
+    def build(num_rows: int, *, chunk_rows: Optional[int] = None,
+              hbm_budget_bytes: Optional[int] = None,
+              bytes_per_row: Optional[int] = None) -> "ChunkPlan":
+        """Partition `num_rows` rows.
+
+        Either pass `chunk_rows` (rounded up to a power of two) or a device
+        budget: the chunk is then the largest power of two such that TWO
+        chunks (current + prefetched) fit in `hbm_budget_bytes` given
+        `bytes_per_row`.  A chunk covering every row degenerates to a
+        single-chunk plan — the streamed oracle then matches the resident
+        one bit-for-bit (tests rely on this).
+        """
+        if num_rows < 1:
+            raise ValueError(f"num_rows must be >= 1, got {num_rows}")
+        if chunk_rows is None:
+            if hbm_budget_bytes is None or bytes_per_row is None:
+                raise ValueError("pass chunk_rows, or hbm_budget_bytes with "
+                                 "bytes_per_row")
+            per_chunk = max(hbm_budget_bytes // (2 * max(bytes_per_row, 1)), 1)
+            chunk_rows = ceil_pow2(per_chunk)
+            if chunk_rows > per_chunk:        # ceil overshot the budget
+                chunk_rows //= 2
+        chunk_rows = int(ceil_pow2(max(int(chunk_rows), MIN_CHUNK_ROWS)))
+        chunk_rows = min(chunk_rows, int(ceil_pow2(num_rows)))
+        chunks = []
+        start = 0
+        while start < num_rows:
+            stop = min(start + chunk_rows, num_rows)
+            rows = stop - start
+            padded = chunk_rows if rows == chunk_rows else int(ceil_pow2(rows))
+            chunks.append(ChunkSpec(index=len(chunks), start=start, stop=stop,
+                                    padded_rows=padded))
+            start = stop
+        return ChunkPlan(num_rows=num_rows, chunk_rows=chunk_rows,
+                         chunks=tuple(chunks))
+
+
+def pad_rows_host(a: np.ndarray, rows: int, fill) -> np.ndarray:
+    """Host-side row pad of a [r, ...] slice to [rows, ...] with `fill`."""
+    r = a.shape[0]
+    if r == rows:
+        return a
+    out = np.full((rows,) + a.shape[1:], fill, a.dtype)
+    out[:r] = a
+    return out
+
+
+class StreamStats:
+    """Transfer-size accounting for one streaming consumer: the
+    `memory_stats()` stand-in on backends that lack it (CPU, some tunneled
+    devices).  `peak_resident_chunks` counts chunks simultaneously alive on
+    device (staged or being consumed) — the double-buffer invariant is that
+    it never exceeds the Prefetcher depth."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total_bytes = 0
+        self.chunks_staged = 0
+        self.passes = 0
+        self.resident_chunks = 0
+        self.resident_bytes = 0
+        self.peak_resident_chunks = 0
+        self.peak_resident_bytes = 0
+
+    def note_staged(self, nbytes: int) -> None:
+        with self._lock:
+            self.total_bytes += nbytes
+            self.chunks_staged += 1
+            self.resident_chunks += 1
+            self.resident_bytes += nbytes
+            self.peak_resident_chunks = max(self.peak_resident_chunks,
+                                            self.resident_chunks)
+            self.peak_resident_bytes = max(self.peak_resident_bytes,
+                                           self.resident_bytes)
+
+    def note_released(self, nbytes: int) -> None:
+        with self._lock:
+            self.resident_chunks -= 1
+            self.resident_bytes -= nbytes
+
+    def note_pass(self) -> None:
+        with self._lock:
+            self.passes += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"total_bytes": self.total_bytes,
+                    "chunks_staged": self.chunks_staged,
+                    "passes": self.passes,
+                    "peak_resident_chunks": self.peak_resident_chunks,
+                    "peak_resident_bytes": self.peak_resident_bytes}
+
+
+def _tree_device_put(host_tree):
+    """Host pytree -> device, via jnp.asarray so dtypes canonicalize exactly
+    as the resident path's transfers do (float64 host arrays become float32
+    under the default config, float64 under x64)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda a: a if a is None else jnp.asarray(a), host_tree,
+        is_leaf=lambda a: a is None)
+
+
+def _tree_nbytes(dev_tree) -> int:
+    import jax
+    return sum(getattr(leaf, "nbytes", 0)
+               for leaf in jax.tree_util.tree_leaves(dev_tree))
+
+
+_DONE = object()
+
+
+class Prefetcher:
+    """Double-buffered host->device chunk pipeline over one ChunkPlan.
+
+    `fetch(spec)` returns the chunk's HOST pytree (sliced + padded numpy
+    arrays); a background thread runs fetch + device transfer for chunk
+    i+1 while the consumer computes on chunk i.  Lookahead is bounded by a
+    semaphore so at most `depth` chunks are device-resident at once —
+    depth=2 is the classic double buffer.  Each `stream()` call is one full
+    pass (one value/gradient evaluation); the thread dies with the pass.
+    Fetch/transfer errors re-raise in the consumer."""
+
+    def __init__(self, plan: ChunkPlan, fetch: Callable[[ChunkSpec], object],
+                 depth: int = 2, stats: Optional[StreamStats] = None):
+        if depth < 2:
+            # the producer stages chunk k only after the consumer has taken
+            # chunk k-depth+1, so depth 1 would deadlock before chunk 0
+            raise ValueError(f"depth must be >= 2, got {depth}")
+        self.plan = plan
+        self.fetch = fetch
+        self.depth = depth
+        self.stats = stats if stats is not None else StreamStats()
+
+    def stream(self) -> Iterator[Tuple[ChunkSpec, object]]:
+        self.stats.note_pass()
+        lookahead = threading.Semaphore(self.depth - 1)
+        q: "queue.Queue" = queue.Queue()
+        cancel = threading.Event()
+
+        def producer():
+            try:
+                for spec in self.plan.chunks:
+                    # token acquired BEFORE staging: the device never holds
+                    # more than `depth` chunks, counting the one the
+                    # consumer is computing on
+                    while not lookahead.acquire(timeout=0.1):
+                        if cancel.is_set():
+                            return
+                    if cancel.is_set():
+                        return
+                    dev = _tree_device_put(self.fetch(spec))
+                    self.stats.note_staged(_tree_nbytes(dev))
+                    q.put((spec, dev))
+                q.put(_DONE)
+            except BaseException as e:  # surfaces in the consumer
+                q.put(e)
+
+        thread = threading.Thread(target=producer, daemon=True,
+                                  name="photon-chunk-prefetch")
+        thread.start()
+        prev_bytes = 0
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise RuntimeError("chunk staging failed") from item
+                spec, dev = item
+                if prev_bytes:
+                    # the consumer asked for chunk i+1 => it has dispatched
+                    # all work on chunk i and dropped its reference
+                    self.stats.note_released(prev_bytes)
+                prev_bytes = _tree_nbytes(dev)
+                lookahead.release()
+                yield spec, dev
+                dev = None
+        finally:
+            cancel.set()
+            if prev_bytes:
+                self.stats.note_released(prev_bytes)

@@ -9,3 +9,4 @@ from photon_ml_tpu.game.coordinates import (  # noqa: F401
     FactoredRandomEffectCoordinate, FixedEffectCoordinate, RandomEffectCoordinate,
 )
 from photon_ml_tpu.game.estimator import GameEstimator, GameResult, select_best_result  # noqa: F401
+from photon_ml_tpu.game.residency import ResidencyManager  # noqa: F401

@@ -53,6 +53,24 @@ class FixedEffectCoordinateConfig:
     # mesh has one wider than 1 (reference regime: >200k-feature
     # treeAggregate depth switch, GameEstimator.scala:667-669)
     shard_features: Optional[bool] = None
+    # device residency of the feature shard (no reference equivalent — Spark
+    # is out-of-core by construction):
+    #   "resident": full shard on device for the whole fit (pre-existing
+    #               behavior, fastest when it fits)
+    #   "streamed": shard stays on HOST; every solve is a double-buffered
+    #               chunk stream (ChunkedGLMObjective + host-stepped
+    #               LBFGS/TRON) bounded by ~2 chunks of HBM
+    #   "auto":     streamed iff the training config carries an
+    #               hbm_budget_bytes the resident shard would bust
+    memory_mode: str = "auto"
+    # power-of-two rows per streamed chunk; None = derived from the HBM
+    # budget (largest pow2 with two chunks inside the coordinate's share)
+    chunk_rows: Optional[int] = None
+
+    def __post_init__(self):
+        if self.memory_mode not in ("auto", "resident", "streamed"):
+            raise ValueError(f"memory_mode must be 'auto', 'resident' or "
+                             f"'streamed', got {self.memory_mode!r}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,7 +85,8 @@ class RandomEffectCoordinateConfig:
     features_to_samples_ratio: Optional[float] = None
     projector: str = "index_map"
 
-    def data_config(self, seed: int = 7) -> RandomEffectDataConfig:
+    def data_config(self, seed: int = 7,
+                    keep_host_blocks: bool = False) -> RandomEffectDataConfig:
         return RandomEffectDataConfig(
             random_effect_type=self.random_effect_type,
             feature_shard=self.feature_shard,
@@ -75,7 +94,8 @@ class RandomEffectCoordinateConfig:
             passive_data_lower_bound=self.passive_data_lower_bound,
             features_to_samples_ratio=self.features_to_samples_ratio,
             projector=self.projector,
-            seed=seed)
+            seed=seed,
+            keep_host_blocks=keep_host_blocks)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,7 +124,8 @@ class FactoredRandomEffectCoordinateConfig:
         if self.num_inner_iterations < 1:
             raise ValueError("num_inner_iterations must be >= 1")
 
-    def data_config(self, seed: int = 7) -> RandomEffectDataConfig:
+    def data_config(self, seed: int = 7,
+                    keep_host_blocks: bool = False) -> RandomEffectDataConfig:
         # features stay in the original shard space ("identity"); the latent
         # projection is part of the MODEL and is refit every update
         return RandomEffectDataConfig(
@@ -113,7 +134,8 @@ class FactoredRandomEffectCoordinateConfig:
             active_data_upper_bound=self.active_data_upper_bound,
             passive_data_lower_bound=self.passive_data_lower_bound,
             projector="identity",
-            seed=seed)
+            seed=seed,
+            keep_host_blocks=keep_host_blocks)
 
 
 CoordinateConfig = Union[FixedEffectCoordinateConfig, RandomEffectCoordinateConfig,
@@ -130,6 +152,13 @@ class GameTrainingConfig:
     updating_sequence: Sequence[str]
     num_outer_iterations: int = 1
     seed: int = 7
+    # HBM residency budget in bytes (None = unbounded, the pre-out-of-core
+    # behavior).  When the training coordinates' device blocks cannot all
+    # fit, fixed-effect shards over budget stream in double-buffered chunks
+    # and inactive coordinates' blocks are evicted between coordinate-
+    # descent visits (see game/residency.py and COMPONENTS.md "Memory
+    # modes").  CLI: --hbm-budget.
+    hbm_budget_bytes: Optional[int] = None
 
     def __post_init__(self):
         missing = [c for c in self.updating_sequence if c not in self.coordinates]
@@ -137,6 +166,9 @@ class GameTrainingConfig:
             raise ValueError(f"updating_sequence names unknown coordinates: {missing}")
         if self.num_outer_iterations < 1:
             raise ValueError("num_outer_iterations must be >= 1")
+        if self.hbm_budget_bytes is not None and self.hbm_budget_bytes <= 0:
+            raise ValueError("hbm_budget_bytes must be positive (use None "
+                             "for unbounded)")
 
     # -- JSON round-trip ------------------------------------------------------
     def to_dict(self) -> dict:
@@ -166,6 +198,13 @@ class GameTrainingConfig:
                                 "feature_shard": c.feature_shard,
                                 "normalization": c.normalization.value,
                                 "shard_features": c.shard_features,
+                                # "auto" (the default) encodes as ABSENT so
+                                # config fingerprints — and therefore
+                                # checkpoints — from before memory modes
+                                # existed stay resumable
+                                "memory_mode": (None if c.memory_mode == "auto"
+                                                else c.memory_mode),
+                                "chunk_rows": c.chunk_rows,
                                 "optimization": enc_glm(c.optimization)}
             elif isinstance(c, FactoredRandomEffectCoordinateConfig):
                 coords[name] = {"kind": "factored_random_effect",
@@ -189,7 +228,8 @@ class GameTrainingConfig:
         return {"task_type": self.task_type, "coordinates": coords,
                 "updating_sequence": list(self.updating_sequence),
                 "num_outer_iterations": self.num_outer_iterations,
-                "seed": self.seed}
+                "seed": self.seed,
+                "hbm_budget_bytes": self.hbm_budget_bytes}
 
     @staticmethod
     def from_dict(d: dict) -> "GameTrainingConfig":
@@ -222,7 +262,9 @@ class GameTrainingConfig:
                     feature_shard=c["feature_shard"],
                     optimization=dec_glm(c["optimization"]),
                     normalization=NormalizationType(c.get("normalization", "none")),
-                    shard_features=c.get("shard_features"))
+                    shard_features=c.get("shard_features"),
+                    memory_mode=c.get("memory_mode") or "auto",
+                    chunk_rows=c.get("chunk_rows"))
             elif c["kind"] == "factored_random_effect":
                 coords[name] = FactoredRandomEffectCoordinateConfig(
                     random_effect_type=c["random_effect_type"],
@@ -246,7 +288,8 @@ class GameTrainingConfig:
             task_type=d["task_type"], coordinates=coords,
             updating_sequence=d["updating_sequence"],
             num_outer_iterations=d.get("num_outer_iterations", 1),
-            seed=d.get("seed", 7))
+            seed=d.get("seed", 7),
+            hbm_budget_bytes=d.get("hbm_budget_bytes"))
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)

@@ -402,6 +402,7 @@ def run_coordinate_descent(
     checkpoint_fingerprint: Optional[str] = None,
     timings: Optional[PhaseTimings] = None,
     timing_mode: str = "pipelined",
+    residency=None,
 ) -> CoordinateDescentResult:
     """reference: CoordinateDescent.run/optimize (scala:57-385).
 
@@ -423,6 +424,14 @@ def run_coordinate_descent(
       - "strict": every update syncs before the next begins (the
         pre-pipelining behavior).  Use when per-phase PhaseTimings spans
         must stay attributable to the device work they launched.
+
+    `residency` (a game.residency.ResidencyManager) rotates device
+    residency under an HBM budget: after a coordinate's update + score +
+    objective (and validation rescore, which reads the VALIDATION dataset's
+    shards, not the training blocks), its device blocks are evicted and the
+    next visit re-streams them from the host copies.  The flat [n] residual
+    score vectors stay device-resident throughout.  Without a budget the
+    manager only keeps byte accounting and the loop is unchanged.
     """
     if timing_mode not in ("pipelined", "strict"):
         raise ValueError(f"timing_mode must be 'pipelined' or 'strict', "
@@ -491,10 +500,16 @@ def run_coordinate_descent(
                     if getattr(cfg, "latent_optimization", None) is not None
                     else 0.0)
             else:
+                if residency is not None:
+                    residency.before_update(name)
                 models[name] = provided
                 scores[name] = coordinates[name].score(provided)
                 reg_terms[name] = coordinates[name].regularization_term(
                     provided)
+                if residency is not None:
+                    # warm-start scoring touched this coordinate's blocks;
+                    # under budget pressure they drop until its first visit
+                    residency.after_update(name)
         total = sum(scores.values(), zeros)
         if not pipelined:
             spans.add_blocked("init/score", _sync(total))
@@ -588,6 +603,8 @@ def run_coordinate_descent(
                 solve_key = f"{it}/{name}/solve"
                 with spans.span(solve_key):
                     coord = coordinates[name]
+                    if residency is not None:
+                        residency.before_update(name)
                     # partial = full - own (reference line 186-193)
                     partial = total - scores[name]
                     models[name], tracker = coord.update(
@@ -654,6 +671,15 @@ def run_coordinate_descent(
                                     best_metric = v
                                     best_model = GameModel(dict(models),
                                                            task_type)
+                if residency is not None:
+                    # update + own-score + objective (and the validation
+                    # rescore, which reads the VALIDATION dataset's shards)
+                    # are all dispatched: under budget pressure this
+                    # coordinate's training blocks drop now and re-stream
+                    # on its next visit.  Dropping Python references is
+                    # queue-safe — XLA keeps buffers alive until in-flight
+                    # consumers finish.
+                    residency.after_update(name)
                 if pipelined:
                     pending.append({"it": it, "name": name,
                                     "solve_key": solve_key,

@@ -15,6 +15,7 @@ import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
 from jax.sharding import Mesh
 
 from photon_ml_tpu.utils.events import (
@@ -51,6 +52,10 @@ class GameResult:
     validation: Dict[str, float]          # final value per evaluator
     descent: CoordinateDescentResult
     validation_specs: List[ValidationSpec] = dataclasses.field(default_factory=list)
+    # HBM residency accounting (ResidencyManager.accounting()): budget,
+    # per-coordinate block bytes, eviction count, tracked peak — the
+    # memory_stats() stand-in bench --stream and the peak-memory test read
+    residency: Optional[dict] = None
 
 
 class GameEstimator:
@@ -87,19 +92,39 @@ class GameEstimator:
                     (dataset.index_maps or {}).get(cfg.feature_shard))
                 cfg = _dc.replace(cfg, optimization=_dc.replace(
                     cfg.optimization, optimizer=opt))
+            budget = self.config.hbm_budget_bytes
             if isinstance(cfg, FixedEffectCoordinateConfig):
                 coords[name] = FixedEffectCoordinate(
                     name, dataset, cfg, self.config.task_type, self.mesh,
-                    seed=self.config.seed)
+                    seed=self.config.seed, hbm_budget_bytes=budget)
             elif isinstance(cfg, FactoredRandomEffectCoordinateConfig):
                 coords[name] = FactoredRandomEffectCoordinate(
                     name, dataset, cfg, self.config.task_type, self.mesh,
-                    seed=self.config.seed)
+                    seed=self.config.seed, hbm_budget_bytes=budget)
             else:
                 coords[name] = RandomEffectCoordinate(
                     name, dataset, cfg, self.config.task_type, self.mesh,
-                    seed=self.config.seed)
+                    seed=self.config.seed, hbm_budget_bytes=budget)
         return coords
+
+    def _residency_manager(self, coords, dataset: GameDataset):
+        """HBM residency bookkeeping (game/residency.py): always built so
+        bench/tests get byte accounting; it only EVICTS when
+        hbm_budget_bytes is set and the coordinates' resident blocks bust
+        it."""
+        import jax as _jax
+
+        from photon_ml_tpu.game.residency import ResidencyManager
+        itemsize = np.dtype(_jax.dtypes.canonicalize_dtype(np.float64)).itemsize
+        n = dataset.num_rows
+        # always-resident flat [n] vectors: per-coordinate scores + total +
+        # base offsets + labels (+ weights) + one int32 lane map per
+        # entity-keyed coordinate
+        flat = (len(self.config.updating_sequence) + 3) * n * itemsize
+        flat += sum(4 * n for c in self.config.coordinates.values()
+                    if hasattr(c, "random_effect_type"))
+        return ResidencyManager(coords, self.config.hbm_budget_bytes,
+                                flat_vector_bytes=flat)
 
     def _config_fingerprint(
             self, evaluator_specs: Optional[Sequence[str]]) -> str:
@@ -172,6 +197,7 @@ class GameEstimator:
         # cost at corpus scale that round 3's phase timings never saw
         with spans.span("build/coordinates"):
             coords = self._build_coordinates(dataset)
+        residency = self._residency_manager(coords, dataset)
         specs = (self._validation_specs(evaluator_specs)
                  if validation_dataset is not None else [])
         initial_models = (dict(initial_model.coordinates)
@@ -189,7 +215,7 @@ class GameEstimator:
             initial_models=initial_models,
             checkpoint_dir=checkpoint_dir, resume=resume,
             checkpoint_fingerprint=fingerprint, timings=spans,
-            timing_mode=timing_mode)
+            timing_mode=timing_mode, residency=residency)
         validation = {name: hist[-1] for name, hist in
                       descent.validation_history.items() if hist}
         if self.emitter is not None:
@@ -203,7 +229,8 @@ class GameEstimator:
         return GameResult(model=descent.best_model, config=self.config,
                           objective_history=descent.objective_history,
                           validation=validation, descent=descent,
-                          validation_specs=specs)
+                          validation_specs=specs,
+                          residency=residency.accounting())
 
     def fit_grid(
         self,

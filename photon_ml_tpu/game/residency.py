@@ -1,0 +1,136 @@
+"""HBM residency budget: decide what lives on device, evict what doesn't fit.
+
+The resident trainer pins EVERY coordinate's device blocks (FE feature
+shards, RE EntityBlocks) for the whole fit; bench config 5 documents the
+consequence — 5M MovieLens rows exhaust a single chip's HBM with four
+coordinates resident.  With a budget (GameTrainingConfig.hbm_budget_bytes /
+--hbm-budget) this manager applies the hierarchy Snap ML's memory manager
+describes (arXiv:1803.06333):
+
+  1. FLAT [n] vectors (residual scores, labels, weights, offsets) ALWAYS
+     stay device-resident: they are touched by every coordinate every
+     update and are ~d times smaller than any feature block.
+  2. A fixed-effect shard whose resident footprint busts the budget runs
+     STREAMED (ChunkedGLMObjective: host shard, two chunks of HBM).
+  3. When the remaining resident coordinates still exceed the budget, the
+     descent loop rotates residency: after a coordinate's update+score its
+     device blocks are EVICTED and re-streamed on its next visit (host
+     copies kept by the out-of-core build, keep_host_blocks).
+
+The manager also keeps the transfer-size accounting (`peak_tracked_bytes`)
+that stands in for device.memory_stats() on backends without it — bench
+--stream and the peak-memory test consume it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, Optional
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+@dataclasses.dataclass
+class CoordinateFootprint:
+    name: str
+    block_bytes: int            # evictable device blocks (FE shard / RE blocks)
+    streamed: bool              # FE chunk streaming (blocks never resident)
+    chunk_bytes: int = 0        # 2-chunk double-buffer cost when streamed
+
+
+class ResidencyManager:
+    """Tracks per-coordinate device footprints against the budget and runs
+    the eviction rotation inside run_coordinate_descent.
+
+    `coordinates` is the built Coordinate map — each coordinate exposes
+    `device_block_bytes()`, `evict_device_blocks()` and (for streamed FE)
+    `streaming_buffer_bytes()`.  Eviction only happens when the budget
+    cannot hold every non-streamed coordinate at once; otherwise the
+    manager is accounting-only and the fit behaves exactly as before."""
+
+    def __init__(self, coordinates: Dict[str, object],
+                 budget_bytes: Optional[int],
+                 flat_vector_bytes: int = 0):
+        self.budget_bytes = budget_bytes
+        self.flat_vector_bytes = flat_vector_bytes
+        self.footprints: Dict[str, CoordinateFootprint] = {}
+        self._coords = coordinates
+        for name, coord in coordinates.items():
+            streamed = bool(getattr(coord, "streamed", False))
+            self.footprints[name] = CoordinateFootprint(
+                name=name,
+                block_bytes=0 if streamed else int(coord.device_block_bytes()),
+                streamed=streamed,
+                chunk_bytes=(int(coord.streaming_buffer_bytes())
+                             if streamed else 0))
+        self.resident_block_total = sum(f.block_bytes
+                                        for f in self.footprints.values())
+        # a streamed coordinate's double buffer is live during ITS update,
+        # concurrently with every still-resident coordinate — so the
+        # no-eviction peak is blocks + flat + the largest chunk buffer
+        # (updates are sequential, so max not sum)
+        stream_peak = max((f.chunk_bytes for f in self.footprints.values()
+                           if f.streamed), default=0)
+        self.evict_inactive = (
+            budget_bytes is not None
+            and (self.resident_block_total + flat_vector_bytes + stream_peak
+                 > budget_bytes)
+            and any(not f.streamed for f in self.footprints.values()))
+        # accounting: what is resident right now / the worst moment so far
+        self._resident: Dict[str, int] = {}
+        self.peak_tracked_bytes = 0
+        self.evictions = 0
+        if self.evict_inactive:
+            logger.info(
+                "hbm budget %.0f MB < resident coordinate blocks %.0f MB "
+                "(+%.0f MB flat vectors): rotating residency — inactive "
+                "coordinates evict after their update and re-stream on the "
+                "next visit", budget_bytes / 1e6,
+                self.resident_block_total / 1e6, flat_vector_bytes / 1e6)
+
+    # -- descent-loop hooks ---------------------------------------------------
+    def before_update(self, name: str) -> None:
+        """Coordinate `name` is about to update: its blocks re-stream on
+        first touch — count them resident from here."""
+        f = self.footprints[name]
+        self._resident[name] = (f.chunk_bytes if f.streamed
+                                else f.block_bytes)
+        current = (sum(self._resident.values()) + self.flat_vector_bytes)
+        self.peak_tracked_bytes = max(self.peak_tracked_bytes, current)
+
+    def after_update(self, name: str) -> None:
+        """Coordinate `name` finished update+score (+objective): under
+        budget pressure its device blocks are dropped NOW; the next visit's
+        lazy accessors re-stream them."""
+        f = self.footprints[name]
+        if f.streamed:
+            # chunks are released by the prefetcher as the pass drains;
+            # account the double buffer as gone once the update returns
+            self._resident.pop(name, None)
+            return
+        if not self.evict_inactive:
+            return
+        self._coords[name].evict_device_blocks()
+        self._resident.pop(name, None)
+        self.evictions += 1
+
+    # -- reporting ------------------------------------------------------------
+    def accounting(self) -> dict:
+        """Byte accounting for bench --stream / training summaries: the
+        stand-in for device.memory_stats() where that API is missing."""
+        return {
+            "budget_bytes": self.budget_bytes,
+            "flat_vector_bytes": self.flat_vector_bytes,
+            "resident_block_bytes": {
+                n: f.block_bytes for n, f in self.footprints.items()
+                if not f.streamed},
+            "streamed_chunk_bytes": {
+                n: f.chunk_bytes for n, f in self.footprints.items()
+                if f.streamed},
+            "resident_block_total": self.resident_block_total,
+            "evict_inactive": self.evict_inactive,
+            "evictions": self.evictions,
+            "peak_tracked_bytes": self.peak_tracked_bytes,
+            "under_budget": (self.budget_bytes is None
+                             or self.peak_tracked_bytes <= self.budget_bytes),
+        }

@@ -6,3 +6,15 @@ from photon_ml_tpu.ops.normalization import (  # noqa: F401
 )
 from photon_ml_tpu.ops.objective import GLMObjective  # noqa: F401
 from photon_ml_tpu.ops import aggregators, features  # noqa: F401
+
+
+def __getattr__(name):  # PEP 562 lazy export
+    # ChunkedGLMObjective pulls in data/streaming, whose package init chains
+    # back into ops via batching -> parallel -> models; resolving it on
+    # first ACCESS (instead of at package init) keeps the import graph
+    # acyclic.  `from photon_ml_tpu.ops import ChunkedGLMObjective` works
+    # unchanged.
+    if name == "ChunkedGLMObjective":
+        from photon_ml_tpu.ops.chunked import ChunkedGLMObjective
+        return ChunkedGLMObjective
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -2,5 +2,8 @@ from photon_ml_tpu.optim.config import (  # noqa: F401
     OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType, solve,
 )
 from photon_ml_tpu.optim.lbfgs import lbfgs, owlqn  # noqa: F401
+from photon_ml_tpu.optim.streaming import (  # noqa: F401
+    host_lbfgs, host_owlqn, host_tron, solve_streamed,
+)
 from photon_ml_tpu.optim.tron import tron  # noqa: F401
 from photon_ml_tpu.optim.types import ConvergenceReason, SolveResult  # noqa: F401
