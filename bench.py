@@ -485,11 +485,19 @@ def _log(msg):
 
 
 def run_game(scale, n_rows, seed, dtype, mode, with_validation=True,
-             salt=0.0, hbm_budget=None):
+             salt=0.0, hbm_budget=None, outer=None, scheduled=False):
     from photon_ml_tpu.game import GameEstimator
     t0 = time.perf_counter()
     train, val, cfg = _game_setup(scale, n_rows, seed, dtype, mode, salt,
                                   hbm_budget=hbm_budget)
+    if outer is not None or scheduled:
+        import dataclasses as _dc
+        cfg = _dc.replace(
+            cfg,
+            num_outer_iterations=(outer if outer is not None
+                                  else cfg.num_outer_iterations),
+            solver_schedule=(_inexact_schedule() if scheduled
+                             else cfg.solver_schedule))
     build_s = time.perf_counter() - t0
     _log(f"game[{scale}/{n_rows}/{dtype().dtype}]: dataset built in "
          f"{build_s:.0f}s; fitting")
@@ -544,11 +552,17 @@ def _data_fingerprint(x_np, y_np) -> str:
     return _FP_CACHE[memo_key][2]
 
 
-def _ref_cache_key(scale, n_rows, seed, mode) -> str:
+def _ref_cache_key(scale, n_rows, seed, mode, outer=None,
+                   scheduled=False) -> str:
     # the GAME data is generated inside run_game, so the key carries the
-    # generator version (bumped on any generator change) instead of a hash
+    # generator version (bumped on any generator change) instead of a hash.
+    # `outer`/`scheduled` suffix keys for --inexact reference fits (custom
+    # outer count / default-schedule fit); the defaults keep every existing
+    # key unchanged
     from photon_ml_tpu.data.synthetic_bench import GENERATOR_VERSION
-    return f"{scale}:{n_rows}:{seed}:{mode}:v={GENERATOR_VERSION}"
+    suffix = "" if outer is None else f":outer{outer}"
+    suffix += ":sched" if scheduled else ""
+    return f"{scale}:{n_rows}:{seed}:{mode}{suffix}:v={GENERATOR_VERSION}"
 
 
 def _ref_cache_get_raw(key: str):
@@ -570,27 +584,38 @@ def _ref_cache_put_raw(key: str, entry) -> None:
         json.dump(cache, f, indent=1, sort_keys=True)
 
 
-def _ref_cache_get(scale, n_rows, seed, mode):
+def _ref_cache_get(scale, n_rows, seed, mode, outer=None, scheduled=False):
     """Cached float64-CPU reference NLL (computed at salt=0; the run salt
     perturbs the objective by ~1e-8 relative — far below the 1e-4 parity
     gate).  The cache is committed so a bench invocation does not pay the
     ~30-minute single-core float64 refit; regenerate any entry by deleting
     it (the subprocess path recomputes and re-saves)."""
-    return _ref_cache_get_raw(_ref_cache_key(scale, n_rows, seed, mode))
+    return _ref_cache_get_raw(_ref_cache_key(scale, n_rows, seed, mode,
+                                             outer, scheduled))
 
 
-def _ref_cache_put(scale, n_rows, seed, mode, entry) -> None:
-    _ref_cache_put_raw(_ref_cache_key(scale, n_rows, seed, mode), entry)
+def _ref_cache_put(scale, n_rows, seed, mode, entry, outer=None,
+                   scheduled=False) -> None:
+    _ref_cache_put_raw(_ref_cache_key(scale, n_rows, seed, mode, outer,
+                                      scheduled), entry)
 
 
-def _start_ref_game(scale, n_rows, seed, mode, salt) -> subprocess.Popen:
+def _start_ref_game(scale, n_rows, seed, mode, salt, outer=None,
+                    scheduled=False) -> subprocess.Popen:
     """Launch the float64 CPU reference fit concurrently (it uses the host
-    CPU while the f32 run uses the accelerator)."""
+    CPU while the f32 run uses the accelerator).  `scheduled` re-runs the
+    SAME fit under the default inexactness schedule — the f64 reference
+    for a scheduled measured leg, per the existing same-fit-at-f64
+    methodology."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1")
     env.pop("XLA_FLAGS", None)
     cmd = [sys.executable, os.path.abspath(__file__), "--game-ref", scale,
            "--n-rows", str(n_rows), "--seed", str(seed),
            "--salt", repr(salt), "--mode", mode]
+    if outer is not None:
+        cmd += ["--outer", str(outer)]
+    if scheduled:
+        cmd += ["--schedule"]
     return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
                             cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -623,8 +648,12 @@ def _game_ref_main(argv):
     seed = int(argv[argv.index("--seed") + 1])
     salt = float(argv[argv.index("--salt") + 1]) if "--salt" in argv else 0.0
     mode = argv[argv.index("--mode") + 1] if "--mode" in argv else "glmix"
+    outer = (int(argv[argv.index("--outer") + 1]) if "--outer" in argv
+             else None)
     result, _, _, _, fit_s = run_game(scale, n_rows, seed, np.float64, mode,
-                                      with_validation=False, salt=salt)
+                                      with_validation=False, salt=salt,
+                                      outer=outer,
+                                      scheduled="--schedule" in argv)
     print(json.dumps({"ref_nll": float(result.objective_history[-1]),
                       "ref_fit_s": round(fit_s, 1)}))
 
@@ -1341,6 +1370,291 @@ def stream_bench(out_path="BENCH_stream.json", smoke=False):
 
 
 # --------------------------------------------------------------------------
+# inexact coordinate descent benchmark (--inexact): strict vs scheduled
+# --------------------------------------------------------------------------
+
+def _inexact_schedule():
+    from photon_ml_tpu.optim import SolverSchedule
+    return SolverSchedule(initial_iterations=4, iteration_growth=2.0,
+                          initial_tolerance_factor=1e3, tolerance_decay=0.1)
+
+
+def _run_descent_scheduled(coords, cfg, train, val, specs, schedule):
+    """One timed descent run, optionally under an inexactness schedule
+    (schedule=None = strict full solves).  Coordinates are pre-built and
+    shared across legs, as in --pipeline: the pair isolates the solve
+    budgets, not data prep or compile time."""
+    from photon_ml_tpu.game.coordinate_descent import (PhaseTimings,
+                                                       run_coordinate_descent)
+    schedules = ({n: schedule for n in cfg.updating_sequence}
+                 if schedule is not None else None)
+    spans = PhaseTimings()
+    t0 = time.perf_counter()
+    res = run_coordinate_descent(
+        coords, cfg.updating_sequence, cfg.num_outer_iterations, train,
+        cfg.task_type, validation_dataset=val, validation_specs=specs,
+        timings=spans, timing_mode="pipelined", solver_schedules=schedules)
+    return res, time.perf_counter() - t0, spans
+
+
+def _inexact_leg_stats(res, wall, spans, cfg):
+    diag = res.solver_diagnostics()
+    return {
+        "fit_s": round(wall, 3),
+        "final_nll": float(res.objective_history[-1]),
+        "solver_iterations": res.total_iterations(),
+        "first_visit_solve_s": {
+            name: round(spans.get(f"0/{name}/solve", 0.0), 3)
+            for name in cfg.updating_sequence},
+        "iterations_by_coordinate": {k: v["iterations"]
+                                     for k, v in diag.items()},
+        "iteration_caps": {k: v["iteration_caps"] for k, v in diag.items()},
+        "reasons": {k: v["reasons"] for k, v in diag.items()},
+    }
+
+
+def _inexact_pair(name, train, val, cfg, parity_gate=None, ref_nll=None,
+                  sched_ref_nll=None, ref_extra=None, schedule=None):
+    """Warm both program variants (1-outer fits compile the static AND the
+    budget-operand solver programs), then time scheduled first and strict
+    LAST so residual cache warming favors strict — the conservative
+    direction for the reported speedup."""
+    import dataclasses as _dc
+
+    from photon_ml_tpu.game import GameEstimator
+
+    est = GameEstimator(cfg)
+    t0 = time.perf_counter()
+    coords = est._build_coordinates(train)
+    build_s = time.perf_counter() - t0
+    specs = est._validation_specs(["AUC"])
+    schedule = schedule or _inexact_schedule()
+    _log(f"inexact[{name}]: coordinates built in {build_s:.1f}s; warmup")
+    warm_cfg = _dc.replace(cfg, num_outer_iterations=1)
+    _run_descent_scheduled(coords, warm_cfg, train, val, specs, schedule)
+    _run_descent_scheduled(coords, warm_cfg, train, val, specs, None)
+    legs = {}
+    for leg, sched in (("scheduled", schedule), ("strict", None)):
+        _log(f"inexact[{name}]: timing {leg}")
+        res, wall, spans = _run_descent_scheduled(coords, cfg, train, val,
+                                                  specs, sched)
+        legs[leg] = _inexact_leg_stats(res, wall, spans, cfg)
+    speedup = legs["strict"]["fit_s"] / max(legs["scheduled"]["fit_s"], 1e-9)
+    final_gap = abs(legs["scheduled"]["final_nll"]
+                    - legs["strict"]["final_nll"]) / max(
+        abs(legs["strict"]["final_nll"]), 1e-12)
+    entry = {
+        "name": name, "task": cfg.task_type, "data": "synthetic-replica",
+        "n_train": train.num_rows, "n_validation": val.num_rows,
+        "outer_iterations": cfg.num_outer_iterations,
+        "coordinates": list(cfg.updating_sequence),
+        "schedule": schedule.to_dict(),
+        "build_s": round(build_s, 2),
+        "strict": legs["strict"], "scheduled": legs["scheduled"],
+        "speedup": round(speedup, 3),
+        "iterations_saved": (legs["strict"]["solver_iterations"]
+                             - legs["scheduled"]["solver_iterations"]),
+        # scheduled-vs-strict final objective gap, REPORTED (not the gate
+        # at this scale): the movielens convex shape's OUTER loop converges
+        # slowly (sweep deltas decay ~0.8x), so at a bench-sized outer
+        # count both trajectories are still approaching the fixed point
+        # and this gap measures outer-loop tail, not solver error.  The
+        # fixed-point equivalence (final full-tolerance visit lands
+        # scheduled on the strict optimum) is gated in the float64 test
+        # suite on a shape that converges (tests/test_inexact.py) and in
+        # the --inexact smoke entry
+        "final_rel_gap_vs_strict": float(final_gap),
+    }
+    if ref_nll is not None:
+        # existing same-fit-at-f64 methodology, hard-gated per leg: each
+        # leg's f32 fit vs the IDENTICAL fit (same budgets) re-run in
+        # float64 on CPU — the strict gate matches bench config 5's convex
+        # gate, the scheduled gate proves the traced-budget machinery is
+        # numerically faithful
+        entry["ref_nll"] = ref_nll
+        entry["sched_ref_nll"] = sched_ref_nll
+        if ref_extra:
+            entry.update(ref_extra)
+        entry["nll_rel_gap_strict"] = round(
+            (legs["strict"]["final_nll"] - ref_nll) / abs(ref_nll), 9)
+        if sched_ref_nll is not None:
+            entry["nll_rel_gap_scheduled"] = round(
+                (legs["scheduled"]["final_nll"] - sched_ref_nll)
+                / abs(sched_ref_nll), 9)
+    if parity_gate is not None:
+        entry["parity_gate"] = parity_gate
+        gaps = [final_gap] if ref_nll is None else [
+            abs(entry["nll_rel_gap_strict"])] + (
+            [abs(entry["nll_rel_gap_scheduled"])]
+            if sched_ref_nll is not None else [])
+        entry["parity_ok"] = bool(max(gaps) <= parity_gate)
+    return entry
+
+
+def _inexact_smoke_dataset(with_mf):
+    """Tiny GLMix (optionally + factored-MF) shape in the AMBIENT dtype
+    (the tier-1 suite runs this under the x64 fixture, like the pipeline
+    smoke).  The convex no-MF variant is the parity-gated one — a unique
+    optimum makes the gate meaningful; the MF variant carries the
+    budget/iterations accounting with the usual non-convex caveat."""
+    import dataclasses as _dc
+
+    from photon_ml_tpu.game import FactoredRandomEffectCoordinateConfig
+    train, val = _pipeline_dataset(4000, d_global=8, n_users=150, d_user=6,
+                                   seed=29)
+    # enough outer iterations that BOTH trajectories reach the block-
+    # coordinate fixed point: the final full-tolerance visit then lands
+    # strict and scheduled on the same optimum (the parity gate measures
+    # outer-loop convergence, not float precision)
+    cfg = _pipeline_config(5, 25, with_item=False, seed=29,
+                           projector="identity")
+    if with_mf:
+        coords = dict(cfg.coordinates)
+        coords["perUserMF"] = FactoredRandomEffectCoordinateConfig(
+            "userId", "per_user", latent_dim=2,
+            optimization=coords["perUser"].optimization,
+            latent_optimization=coords["perUser"].optimization)
+        cfg = _dc.replace(cfg, coordinates=coords,
+                          updating_sequence=[*cfg.updating_sequence,
+                                             "perUserMF"])
+    return train, val, cfg
+
+
+def inexact_bench(out_path="BENCH_inexact.json", smoke=False,
+                  max_wall=None):
+    """Inexact coordinate descent (ISSUE 4): strict full-solve vs
+    scheduled-budget fits on GAME shapes with a factored-MF coordinate,
+    sharing pre-built coordinates and warmed programs (identical
+    methodology to --pipeline).  The convex leg (FE + 2 RE, unique optimum)
+    is hard parity-gated against a float64 CPU reference fit at the
+    existing 1e-4 gate; the factored-MF leg carries the speed claim.  Smoke
+    mode (tier-1 tests/test_bench_smoke.py::test_inexact_smoke) gates
+    parity and the iterations-saved accounting only — seconds-scale CPU
+    timing is noise."""
+    t_suite = time.perf_counter()
+    entries = []
+    truncated = []
+    if smoke:
+        train, val, cfg = _inexact_smoke_dataset(with_mf=False)
+        entries.append(_inexact_pair("smoke_inexact_glmix_convex", train,
+                                     val, cfg, parity_gate=1e-4))
+        train, val, cfg = _inexact_smoke_dataset(with_mf=True)
+        entries.append(_inexact_pair("smoke_inexact_glmix_mf", train, val,
+                                     cfg))
+    else:
+        import dataclasses as _dc
+
+        from photon_ml_tpu.optim import SolverSchedule
+        n_rows = max(int(400_000 * _SCALE), 8000)
+        legs = [
+            # convex movielens-shape config (FE + perUser + perItem): the
+            # hard parity gate — f64 CPU reference fit, unique optimum.
+            # 8 outer iterations so both trajectories reach the block-
+            # coordinate fixed point the final full-tolerance visit lands
+            # on (the gate measures outer-loop convergence, not precision)
+            ("inexact_convex_fe_2re_movielens_shape", "1m", n_rows, 31,
+             "convex", 8, True, None),
+            # the factored-MF movielens-shape config (ISSUE 4 motivation:
+            # BENCH_r05's cold MF solve dominating the fit): the >= 2x
+            # speed claim — strict pays full-tolerance convergence on every
+            # early visit the next coordinate update then perturbs.
+            # Slower cap growth keeps the pre-final visits genuinely cheap
+            # (growth 2.0 reaches near-full caps by the third visit)
+            ("inexact_full_fe_2re_mf_movielens_shape", "1m", n_rows, 31,
+             "full", 4, False,
+             SolverSchedule(initial_iterations=4, iteration_growth=1.5,
+                            initial_tolerance_factor=1e3,
+                            tolerance_decay=0.1)),
+        ]
+        for name, scale, n_rows, seed, mode, outer, with_ref, sched in legs:
+            if max_wall is not None and \
+                    time.perf_counter() - t_suite > max_wall:
+                truncated.append(name)
+                continue
+            # two f64 CPU references for the gated leg — the strict fit
+            # AND the scheduled fit (same budgets) — joined BEFORE the
+            # timed legs run, so on a single-core host the reference work
+            # never contends with the measured wall clocks
+            procs = {}
+            refs = {}
+            try:
+                if with_ref:
+                    for variant, scheduled in (("strict", False),
+                                               ("scheduled", True)):
+                        cached = _ref_cache_get(scale, n_rows, seed, mode,
+                                                outer=outer,
+                                                scheduled=scheduled)
+                        if cached is not None:
+                            refs[variant] = dict(cached, cached=True)
+                        else:
+                            procs[variant] = _start_ref_game(
+                                scale, n_rows, seed, mode, 0.0, outer=outer,
+                                scheduled=scheduled)
+                train, val, cfg = _game_setup(scale, n_rows, seed,
+                                              np.float32, mode, salt=0.0)
+                cfg = _dc.replace(cfg, num_outer_iterations=outer)
+                ref_nll = sched_ref_nll = ref_extra = None
+                if with_ref:
+                    for variant, proc in procs.items():
+                        ref = _join_ref_game(proc)
+                        if "ref_nll" in ref:
+                            _ref_cache_put(scale, n_rows, seed, mode, ref,
+                                           outer=outer,
+                                           scheduled=variant == "scheduled")
+                        refs[variant] = ref
+                    procs = {}
+                    ref_extra = {}
+                    for variant, ref in refs.items():
+                        if "ref_nll" not in ref:
+                            ref_extra[f"ref_error_{variant}"] = ref.get(
+                                "error", "unknown")
+                    ref_nll = refs.get("strict", {}).get("ref_nll")
+                    sched_ref_nll = refs.get("scheduled", {}).get("ref_nll")
+                    ref_extra["ref_fit_s"] = refs.get("strict", {}).get(
+                        "ref_fit_s")
+                    ref_extra["sched_ref_fit_s"] = refs.get(
+                        "scheduled", {}).get("ref_fit_s")
+                    ref_extra["ref_cached"] = bool(
+                        refs.get("strict", {}).get("cached"))
+                entries.append(_inexact_pair(
+                    name, train, val, cfg,
+                    parity_gate=1e-4 if with_ref else None,
+                    ref_nll=ref_nll, sched_ref_nll=sched_ref_nll,
+                    ref_extra=ref_extra, schedule=sched))
+            except BaseException:
+                for proc in procs.values():
+                    proc.kill()
+                    proc.communicate()
+                raise
+    mf_speedups = [e["speedup"] for e in entries
+                   if any("MF" in c for c in e["coordinates"])]
+    gated = [e for e in entries if "parity_ok" in e]
+    result = {
+        "metric": "scheduled_vs_strict_speedup",
+        "value": max(mf_speedups) if mf_speedups else 0.0,
+        "unit": "x",
+        "detail": {
+            "entries": entries,
+            "speedup_floor": 2.0,
+            "speedup_ok": bool(mf_speedups
+                               and max(mf_speedups) >= 2.0),
+            "all_parity_ok": all(e["parity_ok"] for e in gated),
+            "all_iterations_saved": all(e["iterations_saved"] > 0
+                                        for e in entries),
+            "smoke": smoke,
+        },
+    }
+    if truncated:
+        result["detail"]["truncated"] = truncated
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 # smoke benchmark (--smoke): tiny, seconds, CPU-safe, no reference solves
 # --------------------------------------------------------------------------
 
@@ -1576,7 +1890,7 @@ def measure_dispatch_floor(reps: int = 12) -> dict:
             "reps": reps}
 
 
-def main():
+def main(max_wall=None):
     import jax
     import logging
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
@@ -1587,6 +1901,7 @@ def main():
     dispatch_floor = measure_dispatch_floor()
     suite_t0 = time.perf_counter()
     configs = {}
+    truncated = []
     runners = {"1": bench_config1, "2": bench_config2, "3": bench_config3,
                "4": bench_config4, "5": bench_config5, "6": bench_config6,
                "7": bench_config7}
@@ -1597,7 +1912,7 @@ def main():
         gaps = [e.get("nll_rel_gap") for c in configs.values()
                 for e in c.get("entries", [])
                 if e.get("nll_rel_gap") is not None]
-        return {
+        out = {
             "metric": "a1a_logistic_lbfgs_l2_examples_per_sec_per_chip",
             "value": c1.get("examples_per_sec_per_chip", 0.0),
             "unit": "examples/sec/chip",
@@ -1611,10 +1926,32 @@ def main():
                 "configs": configs,
             },
         }
+        if truncated:
+            # partial-but-complete result: the wall budget ran out, the
+            # named configs were SKIPPED, and the process exits 0 — the
+            # harness-timeout alternative (rc=124, JSON lost to a log tail)
+            # is what BENCH_r05 suffered
+            out["detail"]["truncated"] = truncated
+            out["detail"]["max_wall_s"] = max_wall
+        return out
+
+    def write_cumulative():
+        result = cumulative()
+        tmp = "BENCH.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1)
+        os.replace(tmp, "BENCH.json")
+        print(json.dumps(result), flush=True)
+        return result
 
     for key in _CONFIGS:
         key = key.strip()
         if key not in runners:
+            continue
+        if max_wall is not None and \
+                time.perf_counter() - suite_t0 > max_wall:
+            _log(f"--max-wall {max_wall}s exceeded; skipping config {key}")
+            truncated.append(f"config{key}")
             continue
         try:
             t0 = time.perf_counter()
@@ -1637,12 +1974,23 @@ def main():
         # for everything finished so far.  The same dict also lands in
         # BENCH.json (atomic replace) because harness logs keep only the
         # TAIL of stdout — r04's config 1-5 results were lost to truncation
-        result = cumulative()
-        tmp = "BENCH.json.tmp"
-        with open(tmp, "w") as f:
-            json.dump(result, f, indent=1)
-        os.replace(tmp, "BENCH.json")
-        print(json.dumps(result), flush=True)
+        write_cumulative()
+    if truncated:
+        # the skip decisions happen after the last finished config's write:
+        # one more write records the truncated marker in the final JSON
+        return write_cumulative()
+    return cumulative()
+
+
+def _parse_max_wall(argv):
+    """--max-wall SECONDS (or env BENCH_MAX_WALL): suite wall budget.  When
+    exceeded, remaining legs are SKIPPED, the partial JSON carries a
+    "truncated" marker, and the process exits 0 — instead of the harness
+    timeout killing the run at rc=124 with the JSON lost to a log tail."""
+    if "--max-wall" in argv:
+        return float(argv[argv.index("--max-wall") + 1])
+    env = os.environ.get("BENCH_MAX_WALL")
+    return float(env) if env else None
 
 
 if __name__ == "__main__":
@@ -1658,7 +2006,14 @@ if __name__ == "__main__":
         smoke = "--smoke" in sys.argv[2:]
         paths = [a for a in sys.argv[2:] if not a.startswith("--")]
         stream_bench(*(paths[:1] or ["BENCH_stream.json"]), smoke=smoke)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--inexact":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        inexact_bench(*(paths[:1] or ["BENCH_inexact.json"]), smoke=smoke,
+                      max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         smoke_bench(*sys.argv[2:3])
     else:
-        main()
+        main(max_wall=_parse_max_wall(sys.argv[1:]))

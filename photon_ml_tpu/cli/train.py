@@ -624,6 +624,10 @@ def _run(args, log) -> int:
         save_game_model(best.model, os.path.join(args.output_dir, "best"),
                         config=best.config, index_maps=train.index_maps or None,
                         format=args.model_format)
+        # per-coordinate inner-solver accounting (SolveResult already
+        # carried iterations + ConvergenceReason; the fit summary now
+        # surfaces them instead of dropping them on the floor)
+        solver_diag = best.descent.solver_diagnostics()
         summary = {
             "task": args.task,
             "train_rows": train.num_rows,
@@ -631,6 +635,8 @@ def _run(args, log) -> int:
             "num_configs": len(results),
             "final_objective": best.objective_history[-1],
             "validation": best.validation,
+            "solver_iterations_total": best.descent.total_iterations(),
+            "solver_diagnostics": solver_diag,
             "wall_s": round(time.time() - t0, 2),
             "timing_mode": args.timing_mode,
             # HBM residency accounting (None budget = unbounded/resident)
@@ -647,6 +653,10 @@ def _run(args, log) -> int:
         with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
         log.info("summary: %s", summary)
+        for coord, d in solver_diag.items():
+            log.info("solver %-16s solves=%d iterations=%d reasons=%s "
+                     "caps=%s", coord, d["solves"], d["iterations"],
+                     d["reasons"], d["iteration_caps"])
         for name, t in getattr(best.descent, "timings", {}).items():
             log.info("phase %s: %.3fs", name, t)
         print(json.dumps(summary))

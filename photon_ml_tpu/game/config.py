@@ -22,6 +22,7 @@ from photon_ml_tpu.data.batching import RandomEffectDataConfig
 from photon_ml_tpu.ops.normalization import NormalizationType
 from photon_ml_tpu.optim import (
     OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType,
+    SolverSchedule,
 )
 
 
@@ -66,6 +67,10 @@ class FixedEffectCoordinateConfig:
     # power-of-two rows per streamed chunk; None = derived from the HBM
     # budget (largest pow2 with two chunks inside the coordinate's share)
     chunk_rows: Optional[int] = None
+    # per-coordinate inexact-solve schedule; None = inherit the training
+    # config's solver_schedule (optim/schedule.py, COMPONENTS.md "Solver
+    # schedules")
+    solver_schedule: Optional[SolverSchedule] = None
 
     def __post_init__(self):
         if self.memory_mode not in ("auto", "resident", "streamed"):
@@ -84,6 +89,9 @@ class RandomEffectCoordinateConfig:
     passive_data_lower_bound: Optional[int] = None
     features_to_samples_ratio: Optional[float] = None
     projector: str = "index_map"
+    # per-coordinate inexact-solve schedule; None = inherit the training
+    # config's solver_schedule
+    solver_schedule: Optional[SolverSchedule] = None
 
     def data_config(self, seed: int = 7,
                     keep_host_blocks: bool = False) -> RandomEffectDataConfig:
@@ -117,6 +125,10 @@ class FactoredRandomEffectCoordinateConfig:
     latent_optimization: GLMOptimizationConfig = GLMOptimizationConfig()
     active_data_upper_bound: Optional[int] = None
     passive_data_lower_bound: Optional[int] = None
+    # per-coordinate inexact-solve schedule (applies to BOTH the latent-
+    # space and projection-matrix solves); None = inherit the training
+    # config's solver_schedule
+    solver_schedule: Optional[SolverSchedule] = None
 
     def __post_init__(self):
         if self.latent_dim < 1:
@@ -159,6 +171,13 @@ class GameTrainingConfig:
     # descent visits (see game/residency.py and COMPONENTS.md "Memory
     # modes").  CLI: --hbm-budget.
     hbm_budget_bytes: Optional[int] = None
+    # inexact coordinate descent (optim/schedule.py): small iteration caps
+    # + loose tolerances on early outer iterations, geometric tightening,
+    # final outer iteration always at the full configured budget.  Applies
+    # to every coordinate unless a coordinate config carries its own
+    # solver_schedule.  None = strict full solves every visit (the
+    # pre-schedule behavior).  See COMPONENTS.md "Solver schedules".
+    solver_schedule: Optional[SolverSchedule] = None
 
     def __post_init__(self):
         missing = [c for c in self.updating_sequence if c not in self.coordinates]
@@ -191,6 +210,11 @@ class GameTrainingConfig:
                     "regularization_weight": g.regularization_weight,
                     "downsampling_rate": g.downsampling_rate}
 
+        # None (no schedule) encodes as None, which checkpoint fingerprints
+        # strip — records from before solver schedules existed stay resumable
+        def enc_sched(s):
+            return None if s is None else s.to_dict()
+
         coords = {}
         for name, c in self.coordinates.items():
             if isinstance(c, FixedEffectCoordinateConfig):
@@ -205,6 +229,7 @@ class GameTrainingConfig:
                                 "memory_mode": (None if c.memory_mode == "auto"
                                                 else c.memory_mode),
                                 "chunk_rows": c.chunk_rows,
+                                "solver_schedule": enc_sched(c.solver_schedule),
                                 "optimization": enc_glm(c.optimization)}
             elif isinstance(c, FactoredRandomEffectCoordinateConfig):
                 coords[name] = {"kind": "factored_random_effect",
@@ -214,6 +239,7 @@ class GameTrainingConfig:
                                 "num_inner_iterations": c.num_inner_iterations,
                                 "active_data_upper_bound": c.active_data_upper_bound,
                                 "passive_data_lower_bound": c.passive_data_lower_bound,
+                                "solver_schedule": enc_sched(c.solver_schedule),
                                 "optimization": enc_glm(c.optimization),
                                 "latent_optimization": enc_glm(c.latent_optimization)}
             else:
@@ -224,12 +250,14 @@ class GameTrainingConfig:
                                 "passive_data_lower_bound": c.passive_data_lower_bound,
                                 "features_to_samples_ratio": c.features_to_samples_ratio,
                                 "projector": c.projector,
+                                "solver_schedule": enc_sched(c.solver_schedule),
                                 "optimization": enc_glm(c.optimization)}
         return {"task_type": self.task_type, "coordinates": coords,
                 "updating_sequence": list(self.updating_sequence),
                 "num_outer_iterations": self.num_outer_iterations,
                 "seed": self.seed,
-                "hbm_budget_bytes": self.hbm_budget_bytes}
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "solver_schedule": enc_sched(self.solver_schedule)}
 
     @staticmethod
     def from_dict(d: dict) -> "GameTrainingConfig":
@@ -257,6 +285,7 @@ class GameTrainingConfig:
 
         coords: Dict[str, CoordinateConfig] = {}
         for name, c in d["coordinates"].items():
+            sched = SolverSchedule.from_dict(c.get("solver_schedule"))
             if c["kind"] == "fixed_effect":
                 coords[name] = FixedEffectCoordinateConfig(
                     feature_shard=c["feature_shard"],
@@ -264,7 +293,8 @@ class GameTrainingConfig:
                     normalization=NormalizationType(c.get("normalization", "none")),
                     shard_features=c.get("shard_features"),
                     memory_mode=c.get("memory_mode") or "auto",
-                    chunk_rows=c.get("chunk_rows"))
+                    chunk_rows=c.get("chunk_rows"),
+                    solver_schedule=sched)
             elif c["kind"] == "factored_random_effect":
                 coords[name] = FactoredRandomEffectCoordinateConfig(
                     random_effect_type=c["random_effect_type"],
@@ -274,7 +304,8 @@ class GameTrainingConfig:
                     optimization=dec_glm(c["optimization"]),
                     latent_optimization=dec_glm(c["latent_optimization"]),
                     active_data_upper_bound=c.get("active_data_upper_bound"),
-                    passive_data_lower_bound=c.get("passive_data_lower_bound"))
+                    passive_data_lower_bound=c.get("passive_data_lower_bound"),
+                    solver_schedule=sched)
             else:
                 coords[name] = RandomEffectCoordinateConfig(
                     random_effect_type=c["random_effect_type"],
@@ -283,13 +314,15 @@ class GameTrainingConfig:
                     active_data_upper_bound=c.get("active_data_upper_bound"),
                     passive_data_lower_bound=c.get("passive_data_lower_bound"),
                     features_to_samples_ratio=c.get("features_to_samples_ratio"),
-                    projector=c.get("projector", "index_map"))
+                    projector=c.get("projector", "index_map"),
+                    solver_schedule=sched)
         return GameTrainingConfig(
             task_type=d["task_type"], coordinates=coords,
             updating_sequence=d["updating_sequence"],
             num_outer_iterations=d.get("num_outer_iterations", 1),
             seed=d.get("seed", 7),
-            hbm_budget_bytes=d.get("hbm_budget_bytes"))
+            hbm_budget_bytes=d.get("hbm_budget_bytes"),
+            solver_schedule=SolverSchedule.from_dict(d.get("solver_schedule")))
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)

@@ -125,16 +125,54 @@ def _sync(*arrays) -> float:
 class TrackerSummary:
     """Host-side per-solve record (reference: OptimizationStatesTracker
     records per-iteration state + wall clock, OptimizationStatesTracker
-    .scala:32-102; here iterations are summed over vmapped entities)."""
+    .scala:32-102; here iterations are summed over vmapped entities).
+
+    `reasons` counts ConvergenceReason outcomes across the solve's lanes
+    (one entry for a scalar FE solve, per-entity counts for a vmapped RE
+    solve, both sub-solves merged for a factored-MF alternation);
+    `iteration_cap`/`tolerance` record the inexactness budget the solve ran
+    under (None = strict full solve)."""
 
     iterations: int
     wall_s: float
+    reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
+    iteration_cap: Optional[int] = None
+    tolerance: Optional[float] = None
 
 
-def _summarize_tracker(tracker: object, wall_s: float) -> TrackerSummary:
-    it = getattr(tracker, "iterations", None)
-    count = 0 if it is None else int(np.sum(np.asarray(it)))
-    return TrackerSummary(iterations=count, wall_s=wall_s)
+def _reason_counts(reason) -> Dict[str, int]:
+    """{ConvergenceReason name: lane count} from a scalar or [E] array."""
+    from photon_ml_tpu.optim.types import ConvergenceReason
+    if reason is None:
+        return {}
+    arr = np.atleast_1d(np.asarray(reason))
+    out: Dict[str, int] = {}
+    for code, count in zip(*np.unique(arr, return_counts=True)):
+        try:
+            name = ConvergenceReason(int(code)).name
+        except ValueError:
+            name = str(int(code))
+        out[name] = out.get(name, 0) + int(count)
+    return out
+
+
+def _summarize_tracker(tracker: object, wall_s: float,
+                       budget=None) -> TrackerSummary:
+    # a factored-MF tracker carries one SolveResult per half of the
+    # alternation; merge both instead of dropping them on the floor
+    parts = [t for t in (getattr(tracker, "random_effect_result", None),
+                         getattr(tracker, "latent_result", None))
+             if t is not None]
+    if not parts and getattr(tracker, "iterations", None) is not None:
+        parts = [tracker]
+    count = sum(int(np.sum(np.asarray(t.iterations))) for t in parts)
+    reasons: Dict[str, int] = {}
+    for t in parts:
+        for name, c in _reason_counts(getattr(t, "reason", None)).items():
+            reasons[name] = reasons.get(name, 0) + c
+    cap, tol = (None, None) if budget is None else budget
+    return TrackerSummary(iterations=count, wall_s=wall_s, reasons=reasons,
+                          iteration_cap=cap, tolerance=tol)
 
 
 @dataclasses.dataclass
@@ -158,6 +196,26 @@ class CoordinateDescentResult:
         """Sum of inner optimizer iterations across all solves (vmapped RE
         trackers contribute their per-entity counts)."""
         return sum(t.iterations for t in self.trackers.values())
+
+    def solver_diagnostics(self) -> Dict[str, dict]:
+        """Per-coordinate solver totals for the fit summary: solve count,
+        inner iterations actually used, ConvergenceReason outcome counts,
+        and the budget trajectory (iteration caps per visit, None entries =
+        strict full solves).  reference: the per-update
+        OptimizationStatesTracker logs the GAME driver prints."""
+        out: Dict[str, dict] = {}
+        for key, t in sorted(self.trackers.items(),
+                             key=lambda kv: (int(kv[0].split("/")[0]),
+                                             kv[0])):
+            coord = key.split("/", 1)[1]
+            d = out.setdefault(coord, {"solves": 0, "iterations": 0,
+                                       "reasons": {}, "iteration_caps": []})
+            d["solves"] += 1
+            d["iterations"] += t.iterations
+            d["iteration_caps"].append(t.iteration_cap)
+            for name, c in t.reasons.items():
+                d["reasons"][name] = d["reasons"].get(name, 0) + c
+        return out
 
 
 @dataclasses.dataclass
@@ -403,6 +461,7 @@ def run_coordinate_descent(
     timings: Optional[PhaseTimings] = None,
     timing_mode: str = "pipelined",
     residency=None,
+    solver_schedules: Optional[Dict[str, object]] = None,
 ) -> CoordinateDescentResult:
     """reference: CoordinateDescent.run/optimize (scala:57-385).
 
@@ -432,6 +491,15 @@ def run_coordinate_descent(
     next visit re-streams them from the host copies.  The flat [n] residual
     score vectors stay device-resident throughout.  Without a budget the
     manager only keeps byte accounting and the loop is unchanged.
+
+    `solver_schedules` ({coordinate name -> optim.schedule.SolverSchedule
+    or None}) runs inner solves INEXACTLY: early outer iterations get small
+    iteration caps + loose tolerances, tightening geometrically, with the
+    final outer iteration always at the full configured budget.  Budgets
+    ride into the compiled solvers as traced operands (zero recompiles
+    across the schedule), and the budget each solve ran under lands in the
+    trackers.  Scheduling is pure arithmetic in (outer iteration,
+    num_iterations), so checkpoint resume reproduces the trajectory.
     """
     if timing_mode not in ("pipelined", "strict"):
         raise ValueError(f"timing_mode must be 'pipelined' or 'strict', "
@@ -480,12 +548,19 @@ def run_coordinate_descent(
                            "initial/warm-start models are superseded by the "
                            "checkpointed models")
         initial_models = resume.initial_models
+    # factored coordinates starting from their cold default model warm-init
+    # their latent factors from a sibling plain-RE solution at their FIRST
+    # visit (by then the sibling has already been fit this iteration);
+    # provided/resumed models are never overridden
+    cold_factored: set = set()
     with spans.span("init/score"):
         zeros = jnp.zeros(dataset.num_rows)
         models, scores = {}, {}
         for name in updating_sequence:
             provided = (initial_models or {}).get(name)
             if provided is None:
+                if hasattr(coordinates[name], "warm_start_latent"):
+                    cold_factored.add(name)
                 # default initial models are zero-coefficient by
                 # construction (reference: Coordinate.initializeModel), so
                 # their scores are exactly zero — no device work.  The
@@ -581,7 +656,7 @@ def run_coordinate_descent(
             obj = float(obj)
             objective_history.append(obj)
             trackers[f"{p['it']}/{p['name']}"] = _summarize_tracker(
-                p["tracker"], spans[p["solve_key"]])
+                p["tracker"], spans[p["solve_key"]], p["budget"])
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
                         p["it"], p["name"], obj, spans[p["solve_key"]])
             for k, (spec, v) in enumerate(zip(validation_specs, metric_vals)):
@@ -601,14 +676,32 @@ def run_coordinate_descent(
         for it in range(start_iteration, num_iterations):
             for name in updating_sequence:
                 solve_key = f"{it}/{name}/solve"
+                sched = (solver_schedules or {}).get(name)
+                budget_diag = None
+                if sched is not None:
+                    base = coordinates[name].config.optimization \
+                        .optimizer.resolved()
+                    budget_diag = sched.plan(it, num_iterations,
+                                             base.max_iterations,
+                                             base.tolerance)
                 with spans.span(solve_key):
                     coord = coordinates[name]
                     if residency is not None:
                         residency.before_update(name)
+                    if name in cold_factored:
+                        # first visit of a cold factored coordinate: seed
+                        # the latent factors from the sibling plain-RE
+                        # solution (updated earlier in this sequence pass)
+                        cold_factored.discard(name)
+                        warm = coord.warm_start_latent(models[name], models)
+                        if warm is not None:
+                            models[name] = warm
                     # partial = full - own (reference line 186-193)
                     partial = total - scores[name]
                     models[name], tracker = coord.update(
-                        models[name], base_offsets + partial)
+                        models[name], base_offsets + partial,
+                        schedule=sched, outer_iteration=it,
+                        num_outer_iterations=num_iterations)
                     scores[name] = coord.score(models[name])
                     total = partial + scores[name]
                     if not pipelined:
@@ -617,7 +710,7 @@ def run_coordinate_descent(
                     # tracker summaries read device iteration counts — a
                     # per-update sync pipelined mode defers to the flush
                     trackers[f"{it}/{name}"] = _summarize_tracker(
-                        tracker, spans[solve_key])
+                        tracker, spans[solve_key], budget_diag)
 
                 obj_key = f"{it}/{name}/objective"
                 with spans.span(obj_key):
@@ -685,7 +778,8 @@ def run_coordinate_descent(
                                     "solve_key": solve_key,
                                     "objective": obj_dev, "metrics": metrics,
                                     "models": dict(models),
-                                    "tracker": tracker})
+                                    "tracker": tracker,
+                                    "budget": budget_diag})
 
             if pipelined:
                 # outer-iteration boundary: the ONE host sync of the

@@ -258,11 +258,19 @@ class FixedEffectCoordinate:
                            Coefficients.zeros(self.dim, self._canonical)),
             self.config.feature_shard)
 
-    def update(self, model: FixedEffectModel, offsets: jax.Array
+    def update(self, model: FixedEffectModel, offsets: jax.Array,
+               schedule=None, outer_iteration: int = 0,
+               num_outer_iterations: int = 1
                ) -> Tuple[FixedEffectModel, SolveResult]:
         """Refit with residual offsets (partial scores + base offsets).
-        reference: FixedEffectCoordinate.updateModel -> runWithSampling."""
+        reference: FixedEffectCoordinate.updateModel -> runWithSampling.
+
+        `schedule` (optim.schedule.SolverSchedule) turns this into an
+        INEXACT solve: the (iteration cap, tolerance) for this outer
+        iteration ride into the compiled program as traced operands."""
         opt = self.config.optimization
+        budget = (None if schedule is None else schedule.budget_for(
+            outer_iteration, num_outer_iterations, opt.optimizer))
         if self.streamed:
             # ONE [n] readback of the device-resident residual vector per
             # update (vs n*d of streamed feature traffic per oracle pass),
@@ -275,7 +283,8 @@ class FixedEffectCoordinate:
                 x0 = self.norm.model_to_transformed_space(x0)
             res = solve_streamed(obj, x0, opt.optimizer, opt.regularization,
                                  jnp.asarray(opt.regularization_weight,
-                                             self._canonical))
+                                             self._canonical),
+                                 budget=budget)
             c = res.x
             if self.norm is not None:
                 c = self.norm.model_to_original_space(c)
@@ -296,7 +305,8 @@ class FixedEffectCoordinate:
         if self.mesh is not None:
             res = fit_fixed_effect(obj, x0, self.mesh, opt.optimizer,
                                    opt.regularization, opt.regularization_weight,
-                                   shard_features=self.shard_features)
+                                   shard_features=self.shard_features,
+                                   budget=budget)
         else:
             if x0 is model.glm.coefficients.means:
                 # the solver donates x0 (in-place buffer reuse); the model's
@@ -305,7 +315,8 @@ class FixedEffectCoordinate:
                 x0 = jnp.array(x0, copy=True)
             res = _cached_solver(opt.optimizer, opt.regularization,
                                  donate=True)(
-                obj, x0, jnp.asarray(opt.regularization_weight, self.x.dtype))
+                obj, x0, jnp.asarray(opt.regularization_weight, self.x.dtype),
+                budget)
         c = res.x
         if self.norm is not None:
             c = self.norm.model_to_original_space(c)
@@ -472,7 +483,9 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
             global_dim=self.red.global_dim,
             projection_matrix=self.red.projection_matrix)
 
-    def update(self, model: RandomEffectModel, offsets: jax.Array
+    def update(self, model: RandomEffectModel, offsets: jax.Array,
+               schedule=None, outer_iteration: int = 0,
+               num_outer_iterations: int = 1
                ) -> Tuple[RandomEffectModel, SolveResult]:
         """reference: RandomEffectCoordinate.updateModel — the 3-way join +
         per-entity local solves become one gather + one batched solve per
@@ -483,8 +496,11 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
         the concatenate below consumes nothing until all size classes are
         in the device queue, so the accelerator never drains between
         buckets.  Each bucket's x0 slice is donated to its solve for
-        in-place buffer reuse."""
+        in-place buffer reuse.  One `schedule`-derived budget is shared by
+        every bucket (unmapped traced operand of the batched solve)."""
         opt = self.config.optimization
+        budget = (None if schedule is None else schedule.budget_for(
+            outer_iteration, num_outer_iterations, opt.optimizer))
         results = []
         for bucket in self.red.buckets:
             blocks = bucket.with_offsets_from_flat(offsets)
@@ -499,7 +515,8 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
             res_b = fit_random_effects(
                 blocks, self.loss, self.mesh, x0=x0,
                 config=opt.optimizer, reg=opt.regularization,
-                reg_weight=opt.regularization_weight, donate_buffers=True)
+                reg_weight=opt.regularization_weight, donate_buffers=True,
+                budget=budget)
             results.append(res_b)
         res = (results[0] if len(results) == 1 else jax.tree_util.tree_map(
             lambda *a: jnp.concatenate(a, axis=0), *results))
@@ -550,10 +567,63 @@ class FactoredRandomEffectCoordinate(_EntityCoordinateBase):
             entity_ids=self.entity_id_values,
             global_dim=d)
 
-    def update(self, model: FactoredRandomEffectModel, offsets: jax.Array
+    def warm_start_latent(self, model: FactoredRandomEffectModel,
+                          models) -> Optional[FactoredRandomEffectModel]:
+        """Warm latent init from a sibling plain random-effect solution
+        (same entity type, same feature shard, same global space): the
+        Gaussian random projection is replaced with the top-k principal
+        subspace of the sibling's coefficient matrix — the directions
+        per-entity effects actually vary in — so the first alternation
+        refines a meaningful subspace instead of discovering one from
+        noise (BENCH_r05: 398s cold first MF solve vs 7.8s warm revisit).
+
+        The latent FACTORS stay zero: the coordinate's initial score is
+        unchanged, so the descent residual algebra sees no perturbation —
+        in a sequence where the plain RE coordinate is also present, the
+        MF coordinate fits the residual, for which zero is the honest
+        start.  Returns None when no compatible sibling model exists in
+        `models` (the coordinate then cold-starts exactly as before)."""
+        sibling = None
+        for other in models.values():
+            if (isinstance(other, RandomEffectModel)
+                    and other.random_effect_type
+                    == self.config.random_effect_type
+                    and other.feature_shard == self.config.feature_shard
+                    and other.global_dim == self.red.global_dim):
+                sibling = other
+                break
+        if sibling is None:
+            return None
+        w_global = sibling.global_coefficients()        # [E_s, d_global]
+        # align the sibling's entity rows to THIS coordinate's lane order
+        # (different active-data bounds can bucket the same entities into
+        # different orders); entities the sibling never saw stay at zero
+        lookup = {v: i for i, v in enumerate(np.asarray(sibling.entity_ids))}
+        rows = np.fromiter((lookup.get(v, -1) for v in self.entity_id_values),
+                           dtype=np.int64, count=len(self.entity_id_values))
+        gathered = jnp.asarray(w_global)[np.maximum(rows, 0)]
+        gathered = jnp.where(jnp.asarray(rows >= 0)[:, None], gathered, 0.0)
+        from photon_ml_tpu.parallel.factored import (
+            principal_subspace_projection)
+        p = principal_subspace_projection(
+            gathered.astype(model.projection.dtype), model.projection)
+        return dataclasses.replace(model, projection=p)
+
+    def update(self, model: FactoredRandomEffectModel, offsets: jax.Array,
+               schedule=None, outer_iteration: int = 0,
+               num_outer_iterations: int = 1
                ) -> Tuple[FactoredRandomEffectModel, FactoredSolveResult]:
         opt = self.config.optimization
         lat = self.config.latent_optimization
+        re_budget = latent_budget = None
+        if schedule is not None:
+            # one schedule, two base configs: the latent-space and
+            # projection-matrix solves each cap/loosen against their own
+            # configured (max_iterations, tolerance)
+            re_budget = schedule.budget_for(
+                outer_iteration, num_outer_iterations, opt.optimizer)
+            latent_budget = schedule.budget_for(
+                outer_iteration, num_outer_iterations, lat.optimizer)
         blocks = self.red.with_offsets_from_flat(offsets)
 
         latent_row_weights_fn = None
@@ -578,7 +648,8 @@ class FactoredRandomEffectCoordinate(_EntityCoordinateBase):
             re_reg_weight=opt.regularization_weight,
             latent_config=lat.optimizer, latent_reg=lat.regularization,
             latent_reg_weight=lat.regularization_weight,
-            latent_row_weights_fn=latent_row_weights_fn)
+            latent_row_weights_fn=latent_row_weights_fn,
+            re_budget=re_budget, latent_budget=latent_budget)
         new_model = dataclasses.replace(
             model, latent_coefficients=res.latent_coefficients,
             projection=res.projection)

@@ -208,6 +208,11 @@ class GameEstimator:
             from photon_ml_tpu.game.coordinate_descent import read_checkpoint
             fingerprint = self._config_fingerprint(evaluator_specs)
             resume = read_checkpoint(checkpoint_dir, fingerprint)
+        # inexact-solve schedules: a coordinate-level schedule overrides the
+        # training-level one; all-None collapses to the strict no-schedule
+        # path (optim/schedule.py, COMPONENTS.md "Solver schedules")
+        schedules = {name: (c.solver_schedule or self.config.solver_schedule)
+                     for name, c in self.config.coordinates.items()}
         descent = run_coordinate_descent(
             coords, self.config.updating_sequence,
             self.config.num_outer_iterations, dataset, self.config.task_type,
@@ -215,7 +220,9 @@ class GameEstimator:
             initial_models=initial_models,
             checkpoint_dir=checkpoint_dir, resume=resume,
             checkpoint_fingerprint=fingerprint, timings=spans,
-            timing_mode=timing_mode, residency=residency)
+            timing_mode=timing_mode, residency=residency,
+            solver_schedules=(schedules if any(schedules.values())
+                              else None))
         validation = {name: hist[-1] for name, hist in
                       descent.validation_history.items() if hist}
         if self.emitter is not None:

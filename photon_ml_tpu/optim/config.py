@@ -149,6 +149,7 @@ def solve(
     config: OptimizerConfig = OptimizerConfig(),
     reg: RegularizationContext = RegularizationContext(),
     reg_weight: jax.Array | float = 0.0,
+    budget=None,
 ) -> SolveResult:
     """Run one GLM solve: objective + config -> SolveResult.
 
@@ -157,6 +158,13 @@ def solve(
     the smooth objective; L1 goes to OWLQN's pseudo-gradient machinery.
     Fully jittable: wrap in jax.jit (or vmap over a batch of objectives for
     per-entity solves) at the call site.
+
+    `budget` (an optim.schedule.SolveBudget) makes the iteration cap and
+    tolerance TRACED OPERANDS of the compiled program: the config's
+    max_iterations stays the static ceiling (history-buffer size), the loop
+    tests the dynamic cap, and a per-outer-iteration budget schedule
+    compiles nothing new.  `budget=None` keeps the config's static values,
+    which is the identical arithmetic.
     """
     cfg = config.resolved()
     if cfg.constraints is not None:
@@ -165,6 +173,8 @@ def solve(
             "config.resolved_constraints(index_map) before solve()")
     l1_w, l2_w = reg.split(reg_weight)
     obj = objective.with_l2(l2_w)
+    tolerance = cfg.tolerance if budget is None else budget.tolerance
+    iteration_cap = None if budget is None else budget.iteration_cap
 
     if cfg.optimizer == OptimizerType.TRON:
         if reg.has_l1:
@@ -177,15 +187,17 @@ def solve(
             raise ValueError("box constraints are an LBFGS feature "
                              "(reference: LBFGS.scala:72)")
         return tron(obj.value_and_gradient, obj.hessian_vector, x0,
-                    max_iterations=cfg.max_iterations, tolerance=cfg.tolerance,
+                    max_iterations=cfg.max_iterations, tolerance=tolerance,
                     max_cg_iterations=cfg.max_cg_iterations,
-                    track_coefficients=cfg.track_coefficients)
+                    track_coefficients=cfg.track_coefficients,
+                    iteration_cap=iteration_cap)
 
     lower = None if cfg.box_lower is None else jnp.asarray(cfg.box_lower, x0.dtype)
     upper = None if cfg.box_upper is None else jnp.asarray(cfg.box_upper, x0.dtype)
     return lbfgs(obj.value_and_gradient, x0,
-                 max_iterations=cfg.max_iterations, tolerance=cfg.tolerance,
+                 max_iterations=cfg.max_iterations, tolerance=tolerance,
                  history=cfg.history,
                  l1_weight=l1_w if reg.has_l1 else None,
                  lower=lower, upper=upper,
-                 track_coefficients=cfg.track_coefficients)
+                 track_coefficients=cfg.track_coefficients,
+                 iteration_cap=iteration_cap)

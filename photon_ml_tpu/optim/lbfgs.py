@@ -127,6 +127,7 @@ def lbfgs(
     lower: Optional[jax.Array] = None,
     upper: Optional[jax.Array] = None,
     track_coefficients: bool = False,
+    iteration_cap: Optional[jax.Array] = None,
 ) -> SolveResult:
     """Minimize f (+ optional l1*|x|_1, making this OWLQN) from x0.
 
@@ -135,6 +136,12 @@ def lbfgs(
     OWLQN.scala).  `lower`/`upper` activate per-coordinate box projection
     (reference: LBFGS.scala:72 + OptimizationUtils.scala:40-70); box and L1
     are mutually exclusive, as in the reference.
+
+    `max_iterations` is the STATIC ceiling: it sizes the history buffers
+    and bounds the compiled loop.  `iteration_cap` (and `tolerance`) may be
+    TRACED scalars — the loop condition tests the dynamic cap, so an
+    inexactness schedule that varies the budget per coordinate-descent
+    outer iteration reuses one compiled program (optim/schedule.py).
 
     Every line-search trial evaluates the FUSED value+gradient: the first
     trial is accepted in the common case, so this costs 2 X-reads per
@@ -190,6 +197,9 @@ def lbfgs(
             v = v + jnp.sum(l1 * jnp.abs(x))
         return v, g
 
+    cap = (max_iterations if iteration_cap is None
+           else jnp.minimum(jnp.asarray(iteration_cap, jnp.int32),
+                            max_iterations))
     x0 = project_box(x0)
     f0, g0 = full_value(x0)
     gnorm0 = jnp.linalg.norm(steer_grad(x0, g0))
@@ -212,7 +222,7 @@ def lbfgs(
     )
 
     def cond(st: _State):
-        return (st.k < max_iterations) & (st.reason == ConvergenceReason.NOT_CONVERGED)
+        return (st.k < cap) & (st.reason == ConvergenceReason.NOT_CONVERGED)
 
     def body(st: _State) -> _State:
         steer = steer_grad(st.x, st.g)
@@ -328,8 +338,10 @@ def lbfgs(
 
 def owlqn(value_and_grad: ValueAndGrad, x0: jax.Array, *, l1_weight,
           max_iterations: int = 100, tolerance: float = 1e-7,
-          history: int = 10) -> SolveResult:
+          history: int = 10,
+          iteration_cap: Optional[jax.Array] = None) -> SolveResult:
     """L1/elastic-net solver (reference: OWLQN.scala:40-86).  The L2 part of
     elastic net lives in the smooth objective; only L1 comes through here."""
     return lbfgs(value_and_grad, x0, max_iterations=max_iterations,
-                 tolerance=tolerance, history=history, l1_weight=l1_weight)
+                 tolerance=tolerance, history=history, l1_weight=l1_weight,
+                 iteration_cap=iteration_cap)

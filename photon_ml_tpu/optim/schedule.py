@@ -1,0 +1,121 @@
+"""Inexact inner-solve budgets for block coordinate descent.
+
+The GAME outer loop re-perturbs every coordinate's problem on the next
+visit, so paying full-tolerance convergence on early visits is wasted work
+— BENCH_r05 measured a 398s cold factored-MF solve inside a 522s fit whose
+warm revisit cost 7.8s.  Running inner solves inexactly early and
+tightening geometrically toward the end is the standard cure (Trofimov &
+Genkin, arXiv:1611.02101; Snap ML's hierarchical local solvers,
+arXiv:1803.06333).
+
+Two pieces:
+
+  * `SolveBudget` — a (iteration cap, tolerance) pair shipped into the
+    compiled solver programs as TRACED OPERANDS.  The solvers' history
+    buffers stay sized by the static `max_iterations` ceiling and only the
+    `lax.while_loop` condition tests the dynamic cap, so sweeping budgets
+    across outer iterations compiles NOTHING new (regression-tested in
+    tests/test_inexact.py).
+  * `SolverSchedule` — the per-outer-iteration policy: small caps + loose
+    tolerance on early outer iterations, geometric growth/tightening, and
+    the FINAL outer iteration always at the full configured budget so the
+    scheduled fit's final objective matches a strict full-solve fit within
+    the parity gate.
+
+The schedule is pure host-side arithmetic in (outer_iteration,
+num_outer_iterations) — checkpoint resume recomputes identical budgets for
+the remaining iterations, so a resumed scheduled fit reproduces the
+uninterrupted trajectory bit-for-bit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SolveBudget(NamedTuple):
+    """Dynamic inner-solve budget: operands of the compiled solver program
+    (NOT trace constants — that is the whole point)."""
+
+    iteration_cap: jax.Array    # int32 scalar, clipped to the static ceiling
+    tolerance: jax.Array        # float scalar
+
+    @staticmethod
+    def make(iteration_cap: int, tolerance: float) -> "SolveBudget":
+        return SolveBudget(jnp.asarray(int(iteration_cap), jnp.int32),
+                           jnp.asarray(float(tolerance)))
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverSchedule:
+    """Per-(outer-iteration) inexactness schedule for the inner solvers.
+
+    On outer iteration t of N:
+      - t == N-1 (final): the full configured (max_iterations, tolerance) —
+        parity with a strict full-solve fit holds by construction;
+      - t < N-1: iteration cap = initial_iterations * iteration_growth**t
+        (clipped to the configured max_iterations) and tolerance =
+        configured_tolerance * initial_tolerance_factor * tolerance_decay**t
+        (floored at the configured tolerance).
+
+    Applied uniformly to fixed-effect, random-effect, and factored-MF
+    coordinates (both the latent-space and projection-matrix solves).
+    """
+
+    initial_iterations: int = 4
+    iteration_growth: float = 2.0
+    initial_tolerance_factor: float = 1e3
+    tolerance_decay: float = 0.1
+
+    def __post_init__(self):
+        if self.initial_iterations < 1:
+            raise ValueError("initial_iterations must be >= 1")
+        if self.iteration_growth < 1.0:
+            raise ValueError("iteration_growth must be >= 1 (budgets only "
+                             "tighten toward the full solve)")
+        if self.initial_tolerance_factor < 1.0:
+            raise ValueError("initial_tolerance_factor must be >= 1")
+        if not 0.0 < self.tolerance_decay <= 1.0:
+            raise ValueError("tolerance_decay must be in (0, 1]")
+
+    def plan(self, outer_iteration: int, num_outer_iterations: int,
+             max_iterations: int, tolerance: float) -> Tuple[int, float]:
+        """Host-side (iteration cap, tolerance) for one outer iteration."""
+        if outer_iteration >= num_outer_iterations - 1:
+            return max_iterations, tolerance
+        cap = int(round(self.initial_iterations
+                        * self.iteration_growth ** outer_iteration))
+        cap = max(1, min(cap, max_iterations))
+        factor = max(self.initial_tolerance_factor
+                     * self.tolerance_decay ** outer_iteration, 1.0)
+        return cap, tolerance * factor
+
+    def budget_for(self, outer_iteration: int, num_outer_iterations: int,
+                   optimizer_config) -> SolveBudget:
+        """SolveBudget for one (outer iteration, OptimizerConfig).  The
+        returned pair is traced into the solve, so every outer iteration of
+        a scheduled fit reuses ONE compiled program per solver."""
+        r = optimizer_config.resolved()
+        cap, tol = self.plan(outer_iteration, num_outer_iterations,
+                             r.max_iterations, r.tolerance)
+        return SolveBudget.make(cap, tol)
+
+    # -- JSON round-trip (game/config.py embeds schedules in model metadata)
+    def to_dict(self) -> dict:
+        return {"initial_iterations": self.initial_iterations,
+                "iteration_growth": self.iteration_growth,
+                "initial_tolerance_factor": self.initial_tolerance_factor,
+                "tolerance_decay": self.tolerance_decay}
+
+    @staticmethod
+    def from_dict(d) -> "SolverSchedule | None":
+        if d is None:
+            return None
+        return SolverSchedule(
+            initial_iterations=d.get("initial_iterations", 4),
+            iteration_growth=d.get("iteration_growth", 2.0),
+            initial_tolerance_factor=d.get("initial_tolerance_factor", 1e3),
+            tolerance_decay=d.get("tolerance_decay", 0.1))

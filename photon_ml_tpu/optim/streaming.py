@@ -80,12 +80,19 @@ def host_lbfgs(
     l1_weight: Optional[jax.Array | float] = None,
     lower: Optional[jax.Array] = None,
     upper: Optional[jax.Array] = None,
+    iteration_cap: Optional[int] = None,
 ) -> SolveResult:
     """Host-stepped mirror of optim.lbfgs.lbfgs (same constants, same
     two-loop, same Armijo-on-displacement line search, same convergence
     persistence); `value_and_grad` is typically a ChunkedGLMObjective's
     streamed oracle.  Coefficient tracking is not offered — a streamed
-    solve exists precisely because device memory is scarce."""
+    solve exists precisely because device memory is scarce.
+
+    `iteration_cap`/`tolerance` mirror the resident solver's dynamic
+    budget: the loop is host-stepped so varying them never recompiles
+    anything (the jitted helpers are keyed on [d]/[m, d] shapes only);
+    histories stay sized by the static `max_iterations` ceiling so result
+    shapes are budget-independent."""
     use_l1 = l1_weight is not None
     use_box = lower is not None or upper is not None
     if use_l1 and use_box:
@@ -127,6 +134,9 @@ def host_lbfgs(
             v = v + jnp.sum(l1 * jnp.abs(x))
         return v, g
 
+    cap = (max_iterations if iteration_cap is None
+           else max(1, min(int(iteration_cap), max_iterations)))
+    tolerance = float(tolerance)
     x = project_box(x0)
     f, g = full_value(x)
     gnorm = float(jnp.linalg.norm(steer_grad(x, g)))
@@ -143,7 +153,7 @@ def host_lbfgs(
     reason = ConvergenceReason.NOT_CONVERGED
     k = 0
 
-    while k < max_iterations and reason == ConvergenceReason.NOT_CONVERGED:
+    while k < cap and reason == ConvergenceReason.NOT_CONVERGED:
         steer = steer_grad(x, g)
         p = _direction(steer, s_buf, y_buf, rho,
                        jnp.asarray(num_pairs, jnp.int32), m=m)
@@ -227,10 +237,11 @@ def host_lbfgs(
 
 def host_owlqn(value_and_grad: ValueAndGrad, x0: jax.Array, *, l1_weight,
                max_iterations: int = 100, tolerance: float = 1e-7,
-               history: int = 10) -> SolveResult:
+               history: int = 10,
+               iteration_cap: Optional[int] = None) -> SolveResult:
     return host_lbfgs(value_and_grad, x0, max_iterations=max_iterations,
                       tolerance=tolerance, history=history,
-                      l1_weight=l1_weight)
+                      l1_weight=l1_weight, iteration_cap=iteration_cap)
 
 
 def _host_truncated_cg(hess_vec: HessVec, x, g, delta: float, max_cg: int):
@@ -286,9 +297,14 @@ def host_tron(
     max_iterations: int = 15,
     tolerance: float = 1e-5,
     max_cg_iterations: int = 20,
+    iteration_cap: Optional[int] = None,
 ) -> SolveResult:
     """Host-stepped mirror of optim.tron.tron (same eta/sigma constants,
-    radius update, and failure cap)."""
+    radius update, and failure cap); `iteration_cap` mirrors the resident
+    solver's dynamic budget (host-stepped, so never a recompile)."""
+    cap = (max_iterations if iteration_cap is None
+           else max(1, min(int(iteration_cap), max_iterations)))
+    tolerance = float(tolerance)
     x0 = jnp.asarray(x0)
     dtype = x0.dtype
     f, g = value_and_grad(x0)
@@ -303,7 +319,7 @@ def host_tron(
     reason = (ConvergenceReason.GRADIENT_CONVERGED if gnorm <= gtol
               else ConvergenceReason.NOT_CONVERGED)
     k = 0
-    while k < max_iterations and reason == ConvergenceReason.NOT_CONVERGED:
+    while k < cap and reason == ConvergenceReason.NOT_CONVERGED:
         s, shs, hit, cg_n = _host_truncated_cg(hess_vec, x, g, delta,
                                                max_cg_iterations)
         hv_total += cg_n
@@ -357,10 +373,16 @@ def solve_streamed(
     config: OptimizerConfig = OptimizerConfig(),
     reg: RegularizationContext = RegularizationContext(),
     reg_weight: jax.Array | float = 0.0,
+    budget=None,
 ) -> SolveResult:
     """solve() for a ChunkedGLMObjective: same dispatch rules as
     optim.config.solve (L2 into the smooth objective, L1 to OWLQN, TRON
-    constraints), driving the host-stepped loops above."""
+    constraints), driving the host-stepped loops above.
+
+    `budget` (optim.schedule.SolveBudget) overrides the iteration cap and
+    tolerance for this solve — the host-stepped loop branches on host
+    scalars, so a budget schedule never compiles anything new here by
+    construction."""
     cfg = config.resolved()
     if cfg.constraints is not None:
         raise ValueError(
@@ -368,6 +390,8 @@ def solve_streamed(
             "config.resolved_constraints(index_map) before solve_streamed()")
     l1_w, l2_w = reg.split(reg_weight)
     obj = objective.with_l2(l2_w)
+    iteration_cap = None if budget is None else int(budget.iteration_cap)
+    tolerance = cfg.tolerance if budget is None else float(budget.tolerance)
 
     if cfg.optimizer == OptimizerType.TRON:
         if reg.has_l1:
@@ -381,8 +405,9 @@ def solve_streamed(
                              "(reference: LBFGS.scala:72)")
         return host_tron(obj.value_and_gradient, obj.hessian_vector, x0,
                          max_iterations=cfg.max_iterations,
-                         tolerance=cfg.tolerance,
-                         max_cg_iterations=cfg.max_cg_iterations)
+                         tolerance=tolerance,
+                         max_cg_iterations=cfg.max_cg_iterations,
+                         iteration_cap=iteration_cap)
 
     x0 = jnp.asarray(x0)
     lower = (None if cfg.box_lower is None
@@ -391,6 +416,7 @@ def solve_streamed(
              else jnp.asarray(cfg.box_upper, x0.dtype))
     return host_lbfgs(obj.value_and_gradient, x0,
                       max_iterations=cfg.max_iterations,
-                      tolerance=cfg.tolerance, history=cfg.history,
+                      tolerance=tolerance, history=cfg.history,
                       l1_weight=l1_w if reg.has_l1 else None,
-                      lower=lower, upper=upper)
+                      lower=lower, upper=upper,
+                      iteration_cap=iteration_cap)

@@ -107,9 +107,18 @@ def tron(
     tolerance: float = 1e-5,
     max_cg_iterations: int = 20,
     track_coefficients: bool = False,
+    iteration_cap: "jax.Array | None" = None,
 ) -> SolveResult:
-    """Minimize a twice-differentiable objective from x0."""
+    """Minimize a twice-differentiable objective from x0.
+
+    `max_iterations` is the STATIC ceiling (sizes the history buffers);
+    `iteration_cap` and `tolerance` may be TRACED scalars so a per-outer-
+    iteration inexactness budget (optim/schedule.py) reuses one compiled
+    program — the loop condition tests the dynamic cap."""
     dtype = x0.dtype
+    cap = (max_iterations if iteration_cap is None
+           else jnp.minimum(jnp.asarray(iteration_cap, jnp.int32),
+                            max_iterations))
     f0, g0 = value_and_grad(x0)
     gnorm0 = jnp.linalg.norm(g0)
     gtol = tolerance * jnp.maximum(gnorm0, 1.0)  # relative, like the reference's eps |g0|
@@ -144,7 +153,7 @@ def tron(
     )
 
     def cond(st: _S):
-        return (st.k < max_iterations) & (st.reason == ConvergenceReason.NOT_CONVERGED)
+        return (st.k < cap) & (st.reason == ConvergenceReason.NOT_CONVERGED)
 
     def body(st: _S) -> _S:
         s, shs, hit, cg_n = _truncated_cg(hess_vec, st.x, st.g, st.delta,

@@ -70,6 +70,34 @@ def project_blocks(blocks: EntityBlocks, projection: jax.Array) -> EntityBlocks:
     return dataclasses.replace(blocks, x=x_lat * blocks.mask[:, :, None])
 
 
+@jax.jit
+def principal_subspace_projection(w: jax.Array,
+                                  fallback: jax.Array) -> jax.Array:
+    """Warm [k, d] latent projection from a sibling solution matrix.
+
+    Rows = the top-k right singular vectors of w (an [E, d] plain
+    random-effect coefficient matrix): the directions per-entity effects
+    ACTUALLY vary in, instead of the cold Gaussian start whose subspace the
+    first alternation must discover from noise (BENCH_r05: the cold first
+    MF solve was 398s of a 522s fit; warm revisits 7.8s).  The latent
+    factors stay zero, so the coordinate's initial score — and therefore
+    the descent state — is unperturbed.  `fallback` (the existing Gaussian
+    projection) fills rows beyond w's rank and takes over entirely for a
+    degenerate (all-zero) w, where SVD directions are arbitrary."""
+    k = fallback.shape[0]
+    _, s, vt = jnp.linalg.svd(w, full_matrices=False)
+    rows = jnp.minimum(k, vt.shape[0])
+    take = jnp.arange(k) < rows
+    top = jnp.where(take[:, None], vt[jnp.minimum(jnp.arange(k),
+                                                  vt.shape[0] - 1)], fallback)
+    # a zero singular value means the "direction" is arbitrary noise — keep
+    # the Gaussian row instead (also covers an all-zero sibling solution)
+    informative = (s[jnp.minimum(jnp.arange(k), s.shape[0] - 1)]
+                   > 1e-7 * jnp.maximum(s[0], 1e-30)) & take
+    return jnp.where(informative[:, None], top, fallback).astype(
+        fallback.dtype)
+
+
 @dataclasses.dataclass
 class FactoredSolveResult:
     latent_coefficients: jax.Array   # [E, k]
@@ -88,6 +116,7 @@ def refit_latent_projection(
     reg: RegularizationContext = RegularizationContext(),
     reg_weight: jax.Array | float = 0.0,
     row_weights: Optional[jax.Array] = None,
+    budget=None,
 ) -> Tuple[jax.Array, SolveResult]:
     """One projection-matrix refit: flatten the active blocks to rows, treat
     flatten(P) as the coefficient vector of a GLM over the implicit
@@ -115,10 +144,12 @@ def refit_latent_projection(
                        mask=mask)
     p0 = projection.reshape(-1)
     if mesh is not None:
-        res = fit_fixed_effect(obj, p0, mesh, config, reg, reg_weight)
+        res = fit_fixed_effect(obj, p0, mesh, config, reg, reg_weight,
+                               budget=budget)
     else:
         res = _cached_solver(config, reg)(obj, p0,
-                                          jnp.asarray(reg_weight, p0.dtype))
+                                          jnp.asarray(reg_weight, p0.dtype),
+                                          budget)
     return res.x.reshape(k, d), res
 
 
@@ -137,6 +168,8 @@ def fit_factored_random_effects(
     latent_reg: RegularizationContext = RegularizationContext(),
     latent_reg_weight: jax.Array | float = 0.0,
     latent_row_weights_fn: Optional[Callable[[int], Optional[jax.Array]]] = None,
+    re_budget=None,
+    latent_budget=None,
 ) -> FactoredSolveResult:
     """The alternation loop (reference: FactoredRandomEffectCoordinate
     .updateModel, scala:100-160): numInnerIterations rounds of
@@ -144,19 +177,22 @@ def fit_factored_random_effects(
 
     `latent_row_weights_fn(iteration)` supplies optional per-row sampling
     weights for the latent refit (fresh draw per inner iteration, matching
-    runWithSampling's behavior)."""
+    runWithSampling's behavior).  `re_budget`/`latent_budget` apply one
+    dynamic solve budget (optim/schedule.py) to every alternation round's
+    latent-space and projection-matrix solves respectively."""
     C, P = latent_coefficients, projection
     re_res = lat_res = None
     for it in range(num_inner_iterations):
         latent_blocks = project_blocks(blocks, P)
         re_res = fit_random_effects(latent_blocks, loss, mesh, x0=C,
                                     config=re_config, reg=re_reg,
-                                    reg_weight=re_reg_weight)
+                                    reg_weight=re_reg_weight,
+                                    budget=re_budget)
         C = re_res.x
         rw = latent_row_weights_fn(it) if latent_row_weights_fn else None
         P, lat_res = refit_latent_projection(
             blocks, C, P, loss, mesh, latent_config, latent_reg,
-            latent_reg_weight, row_weights=rw)
+            latent_reg_weight, row_weights=rw, budget=latent_budget)
     return FactoredSolveResult(latent_coefficients=C, projection=P,
                                random_effect_result=re_res,
                                latent_result=lat_res)

@@ -89,9 +89,16 @@ def _cached_solver(config: OptimizerConfig, reg: RegularizationContext,
     `donate=True` donates x0 so the solution can reuse its buffer in
     place.  The donated x0 is CONSUMED — callers must pass a buffer
     nothing else references (FixedEffectCoordinate.update copy-guards the
-    live model coefficients before donating)."""
-    return jax.jit(lambda obj, x0, lam: solve(obj, x0, config, reg, lam),
-                   donate_argnums=(1,) if donate else ())
+    live model coefficients before donating).
+
+    `budget` (optim.schedule.SolveBudget) rides in as a TRACED operand:
+    one program serves every (iteration cap, tolerance) an inexactness
+    schedule produces.  budget=None traces the static-config variant — a
+    separate cache entry, not a per-budget retrace."""
+    return jax.jit(
+        lambda obj, x0, lam, budget=None: solve(obj, x0, config, reg, lam,
+                                                budget=budget),
+        donate_argnums=(1,) if donate else ())
 
 
 def fit_fixed_effect(
@@ -102,6 +109,7 @@ def fit_fixed_effect(
     reg: RegularizationContext = RegularizationContext(),
     reg_weight: jax.Array | float = 0.0,
     shard_features: bool = False,
+    budget=None,
 ) -> SolveResult:
     """One distributed fixed-effect solve.  Equivalent in role to
     DistributedOptimizationProblem.run (reference line 103-121)."""
@@ -111,7 +119,8 @@ def fit_fixed_effect(
     x0 = jax.device_put(x0, coef_sharding)
     with mesh:
         return _cached_solver(config, reg)(sharded_obj, x0,
-                                           jnp.asarray(reg_weight, x0.dtype))
+                                           jnp.asarray(reg_weight, x0.dtype),
+                                           budget)
 
 
 @functools.lru_cache(maxsize=8)

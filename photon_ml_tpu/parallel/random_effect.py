@@ -94,16 +94,21 @@ def _cached_batched_solver(loss: PointwiseLoss, config: OptimizerConfig,
     update (offsets/feature blocks have no same-shaped output to alias, so
     donating them would free nothing and warn).  A donated x0 is CONSUMED:
     callers must pass a buffer nothing else references (see
-    fit_random_effects/donate_buffers)."""
+    fit_random_effects/donate_buffers).
 
-    def solve_one(x, labels, mask, weights, offsets, x0_e, lam):
+    The solve `budget` is an UNMAPPED traced operand (one cap/tolerance
+    shared by every vmapped entity solve, like the lambda), so a
+    per-outer-iteration budget schedule reuses this one compiled program."""
+
+    def solve_one(x, labels, mask, weights, offsets, x0_e, lam, budget):
         obj = GLMObjective(loss, x, labels, weights=weights, offsets=offsets,
                            mask=mask)
-        return solve(obj, x0_e, config, reg, lam)
+        return solve(obj, x0_e, config, reg, lam, budget=budget)
 
     return jax.jit(jax.vmap(solve_one,
                             in_axes=(0, 0, 0, 0 if has_weights else None,
-                                     0 if has_offsets else None, 0, None)),
+                                     0 if has_offsets else None, 0, None,
+                                     None)),
                    donate_argnums=(5,) if donate else ())
 
 
@@ -130,6 +135,7 @@ def fit_random_effects(
     reg: RegularizationContext = RegularizationContext(),
     reg_weight: jax.Array | float = 0.0,
     donate_buffers: bool = False,
+    budget=None,
 ) -> SolveResult:
     """All per-entity solves as one batched program.
 
@@ -159,7 +165,7 @@ def fit_random_effects(
                                      donate=donate_buffers and mesh is None)
     if mesh is None:
         return batched(blocks.x, blocks.labels, blocks.mask,
-                       blocks.weights, blocks.offsets, x0, lam)
+                       blocks.weights, blocks.offsets, x0, lam, budget)
 
     # auto-pad the entity axis to a mesh multiple with all-masked lanes
     # (real datasets are rarely device-count multiples); results sliced back.
@@ -194,7 +200,7 @@ def fit_random_effects(
                    else put(zfill(blocks.offsets, 0.0)))
     with mesh:
         res = batched(x_dev, labels_dev, mask_dev, weights_dev, offsets_dev,
-                      put(zfill(x0, 0.0)), lam)
+                      put(zfill(x0, 0.0)), lam, budget)
     if pad_e:
         res = jax.tree_util.tree_map(lambda a: a[:E], res)
     return res

@@ -76,6 +76,62 @@ def test_stream_smoke(tmp_path):
         assert entry[mode]["fit_s"] > 0
 
 
+def test_inexact_smoke(tmp_path):
+    """bench.py --inexact --smoke end-to-end in tier-1 (ISSUE 4 satellite):
+    the strict-vs-scheduled harness — budget plumbing, warm latent init,
+    per-solve diagnostics, parity gating — cannot rot without failing the
+    normal test run.  Timing is a smoke signal; the >= 2x speedup bar is
+    enforced by the full bench leg, not here."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_inexact.json"
+    result = bench.inexact_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    # the convex entry is the hard parity gate (unique optimum: the final
+    # full-tolerance visit must land strict and scheduled together)
+    assert detail["all_parity_ok"] is True
+    convex = next(e for e in detail["entries"] if "convex" in e["name"])
+    assert convex["parity_ok"] is True
+    assert convex["final_rel_gap_vs_strict"] <= convex["parity_gate"]
+    # every entry actually ran INEXACTLY: fewer inner iterations than the
+    # strict full-solve leg, capped early visits, full final visit
+    assert detail["all_iterations_saved"] is True
+    for e in detail["entries"]:
+        assert e["iterations_saved"] > 0
+        for coord, caps in e["scheduled"]["iteration_caps"].items():
+            assert caps[0] is not None and caps[0] <= 4
+        assert all(c is None for caps in
+                   e["strict"]["iteration_caps"].values() for c in caps)
+        assert e["strict"]["fit_s"] > 0 and e["scheduled"]["fit_s"] > 0
+    mf = next(e for e in detail["entries"] if "mf" in e["name"])
+    assert "perUserMF" in mf["coordinates"]
+
+
+def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
+    """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
+    SKIPS the remaining configs, writes the partial JSON with a
+    "truncated" marker, and returns normally (exit 0) — instead of the
+    harness timeout killing the run at rc=124 with the JSON lost."""
+    bench = _load_bench()
+    monkeypatch.chdir(tmp_path)
+    result = bench.main(max_wall=0.0)
+    assert result["detail"]["truncated"]          # every config skipped
+    assert result["detail"]["configs"] == {}
+    assert result["detail"]["max_wall_s"] == 0.0
+    on_disk = json.loads((tmp_path / "BENCH.json").read_text())
+    assert on_disk["detail"]["truncated"] == result["detail"]["truncated"]
+    # the inexact leg honors the same budget
+    out = tmp_path / "BENCH_inexact.json"
+    r = bench.inexact_bench(str(out), smoke=False, max_wall=0.0)
+    assert r["detail"]["truncated"]
+    assert r["detail"]["entries"] == []
+
+
 def test_bench_smoke_writes_no_repo_state(tmp_path, monkeypatch):
     """Smoke mode must not touch the committed bench caches (it is run by
     the tier-1 suite, which may not write repo files)."""
