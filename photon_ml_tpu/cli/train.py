@@ -179,6 +179,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "thread.  strict: sync after every update (same "
                         "math bit-for-bit; per-phase timings stay "
                         "attributable to the device work they launched)")
+    p.add_argument("--fault-plan", default=None,
+                   help="ARM FAULT INJECTION (testing/chaos runs only): "
+                        "FaultPlan JSON (inline or @file) of named "
+                        "injection sites x trigger hits/probabilities "
+                        "(utils/faults.py; same format as the "
+                        "PHOTON_FAULT_PLAN env var, which also works).  "
+                        "With no plan the injection sites are zero-"
+                        "overhead no-ops.  On SIGTERM/SIGINT the trainer "
+                        "exits RESUMABLY (status 75, EX_TEMPFAIL) after "
+                        "finishing the in-flight coordinate update and "
+                        "making the newest checkpoint durable")
     return p
 
 
@@ -396,6 +407,19 @@ def _run(args, log) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
 
+    # fault containment control plane (utils/faults.py): an env- or
+    # flag-armed injection plan (chaos/testing runs), and SIGTERM/SIGINT
+    # graceful preemption — finish the in-flight coordinate update, make
+    # the newest checkpoint durable, exit with the resumable status 75
+    from photon_ml_tpu.utils import faults
+    fault_plan = faults.install_from_env()
+    if args.fault_plan:
+        fault_plan = faults.FaultPlan.from_dict(
+            _load_json_arg(args.fault_plan))
+        faults.install_plan(fault_plan)
+        log.warning("fault plan ACTIVE from --fault-plan: %d spec(s)",
+                    len(fault_plan.specs))
+
     # persistent compile cache + honest compile accounting (the reference
     # pays no compile cost — JVM/Breeze interprets; a warm cache is our
     # equivalent posture, and compile_s in the summary proves it worked)
@@ -533,6 +557,8 @@ def _run(args, log) -> int:
         profile_ctx.__enter__()
         print(f"profiling to {profile_dir}", file=sys.stderr)
 
+    preempt_guard = faults.GracefulPreemption()
+    preempt_guard.__enter__()
     try:
         initial_model = None
         if args.initial_model_dir:
@@ -637,6 +663,15 @@ def _run(args, log) -> int:
             "validation": best.validation,
             "solver_iterations_total": best.descent.total_iterations(),
             "solver_diagnostics": solver_diag,
+            # fault containment accounting: quarantine events (rollbacks /
+            # tightened retries / freezes), coordinates left frozen, how
+            # the checkpoint was recovered at resume, and — on chaos runs —
+            # the injection plan's per-site fire counts
+            "containment_events": best.descent.containment_events,
+            "frozen_coordinates": best.descent.frozen_coordinates,
+            "checkpoint_recovery": best.checkpoint_recovery,
+            "fault_report": (fault_plan.report() if fault_plan is not None
+                             else None),
             "wall_s": round(time.time() - t0, 2),
             "timing_mode": args.timing_mode,
             # HBM residency accounting (None budget = unbounded/resident)
@@ -661,7 +696,27 @@ def _run(args, log) -> int:
             log.info("phase %s: %.3fs", name, t)
         print(json.dumps(summary))
         return 0
+    except faults.Preempted as e:
+        # graceful preemption (SIGTERM/SIGINT): the in-flight coordinate
+        # update finished and the newest checkpoint record is durable —
+        # report resumability and exit with the DISTINCT status 75
+        # (EX_TEMPFAIL) so schedulers relaunch the same command
+        payload = {
+            "preempted": True,
+            "completed_iterations": e.completed_iterations,
+            "resumable": e.checkpointed,
+            "checkpoint_dir": e.checkpoint_dir,
+            "exit_status": faults.EXIT_PREEMPTED,
+            "wall_s": round(time.time() - t0, 2),
+        }
+        log.warning("preempted: %s", e)
+        with open(os.path.join(args.output_dir,
+                               "training-summary.json"), "w") as f:
+            json.dump(payload, f, indent=2)
+        print(json.dumps(payload))
+        return faults.EXIT_PREEMPTED
     finally:
+        preempt_guard.__exit__(None, None, None)
         if profile_ctx is not None:
             profile_ctx.__exit__(None, None, None)
         # listeners flush buffered events in close() — run even when

@@ -29,18 +29,40 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import random
 import threading
+import time
 from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.utils import faults
 from photon_ml_tpu.utils.math import ceil_pow2
 
 # never plan chunks smaller than this: per-chunk dispatch overhead would
 # dominate (over a tunneled device each program dispatch costs ~the floor
 # bench.py measures via measure_dispatch_floor)
 MIN_CHUNK_ROWS = 256
+
+# staging retry policy: a flaky host read / device transfer must not kill an
+# hours-long fit.  Transient failures (faults.is_transient: OSError,
+# timeouts, injected TransientFault, ...) retry up to STAGE_MAX_ATTEMPTS
+# with jittered exponential backoff; everything else — and always
+# KeyboardInterrupt/SystemExit — propagates immediately.
+STAGE_MAX_ATTEMPTS = 3
+STAGE_BACKOFF_S = 0.05
+STAGE_BACKOFF_JITTER = 0.5
+
+
+class ChunkStagingError(RuntimeError):
+    """A chunk failed to stage after exhausting its retry budget (or hit a
+    fatal, non-retryable error).  The message names the chunk; the original
+    failure rides as __cause__."""
+
+    def __init__(self, message: str, chunk_index: int):
+        super().__init__(message)
+        self.chunk_index = chunk_index
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,6 +170,18 @@ class StreamStats:
         self.resident_bytes = 0
         self.peak_resident_chunks = 0
         self.peak_resident_bytes = 0
+        # retry accounting: transient staging failures absorbed (retries)
+        # and chunks that exhausted the retry budget (gave_up)
+        self.retries = 0
+        self.gave_up = 0
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def note_gave_up(self) -> None:
+        with self._lock:
+            self.gave_up += 1
 
     def note_staged(self, nbytes: int) -> None:
         with self._lock:
@@ -175,7 +209,9 @@ class StreamStats:
                     "chunks_staged": self.chunks_staged,
                     "passes": self.passes,
                     "peak_resident_chunks": self.peak_resident_chunks,
-                    "peak_resident_bytes": self.peak_resident_bytes}
+                    "peak_resident_bytes": self.peak_resident_bytes,
+                    "retries": self.retries,
+                    "gave_up": self.gave_up}
 
 
 def _tree_device_put(host_tree):
@@ -206,26 +242,77 @@ class Prefetcher:
     semaphore so at most `depth` chunks are device-resident at once —
     depth=2 is the classic double buffer.  Each `stream()` call is one full
     pass (one value/gradient evaluation); the thread dies with the pass.
-    Fetch/transfer errors re-raise in the consumer."""
+
+    Failure containment: TRANSIENT staging errors (faults.is_transient —
+    OSError/timeouts/injected TransientFault) retry up to `max_attempts`
+    with jittered exponential backoff (StreamStats counts the retries);
+    a chunk that exhausts its budget raises ChunkStagingError naming the
+    chunk in the consumer.  Fatal errors skip the retry loop entirely, and
+    KeyboardInterrupt/SystemExit re-raise AS THEMSELVES in the consumer —
+    an operator interrupt must never be laundered into a staging error."""
 
     def __init__(self, plan: ChunkPlan, fetch: Callable[[ChunkSpec], object],
-                 depth: int = 2, stats: Optional[StreamStats] = None):
+                 depth: int = 2, stats: Optional[StreamStats] = None,
+                 max_attempts: int = STAGE_MAX_ATTEMPTS,
+                 backoff_s: float = STAGE_BACKOFF_S):
         if depth < 2:
             # the producer stages chunk k only after the consumer has taken
             # chunk k-depth+1, so depth 1 would deadlock before chunk 0
             raise ValueError(f"depth must be >= 2, got {depth}")
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
         self.plan = plan
         self.fetch = fetch
         self.depth = depth
+        self.max_attempts = max_attempts
+        self.backoff_s = backoff_s
         self.stats = stats if stats is not None else StreamStats()
+
+    def _stage_with_retry(self, spec: ChunkSpec, jitter: random.Random):
+        """fetch + device transfer for one chunk, absorbing transient
+        failures up to the attempt budget."""
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                faults.fire("stage.fetch", chunk=spec.index)
+                host = self.fetch(spec)
+                faults.fire("stage.transfer", chunk=spec.index)
+                return _tree_device_put(host)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                if not faults.is_transient(e):
+                    self.stats.note_gave_up()
+                    raise ChunkStagingError(
+                        f"chunk staging failed for chunk {spec.index} of "
+                        f"{self.plan.num_chunks} (fatal "
+                        f"{type(e).__name__}, not retryable)",
+                        spec.index) from e
+                if attempt >= self.max_attempts:
+                    self.stats.note_gave_up()
+                    raise ChunkStagingError(
+                        f"chunk staging failed for chunk {spec.index} of "
+                        f"{self.plan.num_chunks} after {attempt} "
+                        f"attempt(s)", spec.index) from e
+                self.stats.note_retry()
+                # exponential backoff with jitter so concurrent streams
+                # don't re-hammer a struggling source in lockstep
+                delay = (self.backoff_s * (2 ** (attempt - 1))
+                         * (1.0 + STAGE_BACKOFF_JITTER * jitter.random()))
+                time.sleep(delay)
 
     def stream(self) -> Iterator[Tuple[ChunkSpec, object]]:
         self.stats.note_pass()
         lookahead = threading.Semaphore(self.depth - 1)
         q: "queue.Queue" = queue.Queue()
         cancel = threading.Event()
+        # deterministic per-pass jitter (seeded by the pass ordinal) keeps
+        # retry timing reproducible for a given plan + failure sequence
+        jitter = random.Random(self.stats.passes)
 
         def producer():
+            spec = None
             try:
                 for spec in self.plan.chunks:
                     # token acquired BEFORE staging: the device never holds
@@ -236,12 +323,24 @@ class Prefetcher:
                             return
                     if cancel.is_set():
                         return
-                    dev = _tree_device_put(self.fetch(spec))
+                    dev = self._stage_with_retry(spec, jitter)
                     self.stats.note_staged(_tree_nbytes(dev))
                     q.put((spec, dev))
                 q.put(_DONE)
-            except BaseException as e:  # surfaces in the consumer
+            except (KeyboardInterrupt, SystemExit) as e:
+                # NOT a staging failure: re-raise distinctly in the
+                # consumer (the operator interrupted / the process is
+                # exiting), never wrapped into a RuntimeError
+                q.put(("interrupt", e))
+            except ChunkStagingError as e:  # already named + chained
                 q.put(e)
+            except BaseException as e:  # unexpected: name the chunk anyway
+                idx = spec.index if spec is not None else -1
+                err = ChunkStagingError(
+                    f"chunk staging failed for chunk {idx} of "
+                    f"{self.plan.num_chunks}", max(idx, 0))
+                err.__cause__ = e
+                q.put(err)
 
         thread = threading.Thread(target=producer, daemon=True,
                                   name="photon-chunk-prefetch")
@@ -252,8 +351,11 @@ class Prefetcher:
                 item = q.get()
                 if item is _DONE:
                     return
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] == "interrupt":
+                    raise item[1]
                 if isinstance(item, BaseException):
-                    raise RuntimeError("chunk staging failed") from item
+                    raise item
                 spec, dev = item
                 if prev_bytes:
                     # the consumer asked for chunk i+1 => it has dispatched

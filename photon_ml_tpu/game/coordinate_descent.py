@@ -19,6 +19,8 @@ import contextlib
 import dataclasses
 import functools
 import logging
+import math
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -28,9 +30,11 @@ import numpy as np
 
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.evaluation.evaluators import Evaluator, MultiEvaluator
+from photon_ml_tpu.game import quarantine as quarantine_mod
 from photon_ml_tpu.game.coordinates import Coordinate
 from photon_ml_tpu.models.game import GameModel
 from photon_ml_tpu.ops import TASK_LOSSES
+from photon_ml_tpu.utils import faults
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -131,13 +135,16 @@ class TrackerSummary:
     (one entry for a scalar FE solve, per-entity counts for a vmapped RE
     solve, both sub-solves merged for a factored-MF alternation);
     `iteration_cap`/`tolerance` record the inexactness budget the solve ran
-    under (None = strict full solve)."""
+    under (None = strict full solve); `containment` records a quarantine
+    outcome for the visit (None = healthy solve; "rolled_back" /
+    "retry_ok" / "frozen", game/quarantine.py)."""
 
     iterations: int
     wall_s: float
     reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
     iteration_cap: Optional[int] = None
     tolerance: Optional[float] = None
+    containment: Optional[str] = None
 
 
 def _reason_counts(reason) -> Dict[str, int]:
@@ -191,6 +198,11 @@ class CoordinateDescentResult:
     # the lifetime of every GameResult in a sweep
     # (reference: OptimizationStatesTracker per update)
     trackers: Dict[str, "TrackerSummary"] = dataclasses.field(default_factory=dict)
+    # quarantine containment log (game/quarantine.py QuarantineMonitor
+    # events: rollbacks, tightened-budget retries, freezes) — empty on a
+    # healthy fit
+    containment_events: List[dict] = dataclasses.field(default_factory=list)
+    frozen_coordinates: List[str] = dataclasses.field(default_factory=list)
 
     def total_iterations(self) -> int:
         """Sum of inner optimizer iterations across all solves (vmapped RE
@@ -209,10 +221,14 @@ class CoordinateDescentResult:
                                              kv[0])):
             coord = key.split("/", 1)[1]
             d = out.setdefault(coord, {"solves": 0, "iterations": 0,
-                                       "reasons": {}, "iteration_caps": []})
+                                       "reasons": {}, "iteration_caps": [],
+                                       "containment": {}})
             d["solves"] += 1
             d["iterations"] += t.iterations
             d["iteration_caps"].append(t.iteration_cap)
+            if t.containment is not None:
+                d["containment"][t.containment] = \
+                    d["containment"].get(t.containment, 0) + 1
             for name, c in t.reasons.items():
                 d["reasons"][name] = d["reasons"].get(name, 0) + c
         return out
@@ -221,7 +237,11 @@ class CoordinateDescentResult:
 @dataclasses.dataclass
 class CheckpointState:
     """One resumable record (no reference equivalent — a failed Spark
-    driver restarts the job from scratch, SURVEY §5.3)."""
+    driver restarts the job from scratch, SURVEY §5.3).  `recovery`
+    documents HOW the record was recovered: {"fallback": bool,
+    "resumed_from_iteration": k, "pruned": [paths]} — fallback=True means
+    the primary state.json was missing/corrupt/unverifiable and the record
+    came from the newest iter-*/manifest-verified directory."""
 
     completed_iterations: int
     initial_models: Dict[str, object]
@@ -229,6 +249,102 @@ class CheckpointState:
     validation_history: Dict[str, List[float]]
     best_models: Optional[Dict[str, object]]    # None = same as latest
     best_metric: Optional[float]
+    recovery: Optional[dict] = None
+
+
+# -- crash-safe checkpoint plumbing ------------------------------------------
+#
+# Write discipline (everything inside the checkpoint directory):
+#   iter-KKKK/<model files>         save_game_model layout
+#   iter-KKKK/record.json           the FULL state record, self-contained
+#                                   (relative dir references) — the fallback
+#                                   unit when state.json is torn
+#   iter-KKKK/manifest.json         per-file sizes + sha256, written LAST
+#                                   via tmp+rename (+fsync): a directory
+#                                   with a verifying manifest is COMPLETE
+#   best-KKKK/...                   same manifest discipline
+#   state.json                      atomic pointer to the newest record
+#                                   (tmp -> fsync -> rename -> dir fsync)
+#
+# Retention is TWO records: the newest and its predecessor, so a record
+# whose files turn out corrupt at resume still has a verified fallback.
+# Resume order: state.json (manifest-verified) -> newest iter-* directory
+# whose manifest verifies -> fresh start; stale *.tmp files and orphaned
+# partial directories (no/failing manifest, unreferenced) are pruned.
+
+def _fsync_file(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # best-effort (exotic filesystems)
+        pass
+
+
+def _fsync_dir(path: str) -> None:
+    _fsync_file(path)
+
+
+def _file_sha256(path: str) -> str:
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _write_manifest(dirpath: str) -> None:
+    """Scan `dirpath` and write manifest.json LAST (tmp+rename+fsync): the
+    completeness marker a resume verifies.  Every data file is fsynced
+    first so a verifying manifest implies durable contents."""
+    files = {}
+    for root, _, names in os.walk(dirpath):
+        for fn in sorted(names):
+            if fn in ("manifest.json", "manifest.json.tmp"):
+                continue
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, dirpath)
+            _fsync_file(p)
+            files[rel] = {"bytes": os.path.getsize(p),
+                          "sha256": _file_sha256(p)}
+    import json
+    tmp = os.path.join(dirpath, "manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"format_version": 1, "files": files}, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dirpath, "manifest.json"))
+    _fsync_dir(dirpath)
+
+
+def verify_checkpoint_dir(dirpath: str) -> Tuple[Optional[bool], str]:
+    """-> (ok, reason).  ok=True: manifest present and every listed file
+    matches size + checksum.  ok=False: torn/corrupt.  ok=None: no
+    manifest (a legacy pre-manifest record, or a partial write that died
+    before the manifest landed — the caller decides by reference)."""
+    import json
+    mpath = os.path.join(dirpath, "manifest.json")
+    if not os.path.isdir(dirpath):
+        return False, "missing directory"
+    if not os.path.exists(mpath):
+        return None, "no manifest"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        for rel, want in manifest["files"].items():
+            p = os.path.join(dirpath, rel)
+            if not os.path.exists(p):
+                return False, f"missing file {rel}"
+            if os.path.getsize(p) != want["bytes"]:
+                return False, f"size mismatch for {rel}"
+            if _file_sha256(p) != want["sha256"]:
+                return False, f"checksum mismatch for {rel}"
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return False, f"unreadable manifest ({e})"
+    return True, "ok"
 
 
 def _write_checkpoint(directory: str, iteration: int, model: GameModel,
@@ -241,15 +357,19 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
     after an outer iteration.
 
     Layout: {dir}/iter-{k:04d}/ and {dir}/best-{k:04d}/ (save_game_model
-    format) + {dir}/state.json.  The state file is replaced ATOMICALLY and
-    LAST, so a crash mid-save leaves the previous record intact; the model
-    directories a superseded record pointed at are pruned afterwards."""
+    format, each sealed by a per-file size+sha256 manifest.json written
+    LAST) + {dir}/state.json (replaced ATOMICALLY after an fsync, and
+    LAST, so a crash mid-save leaves the previous record intact).  Each
+    iter directory also embeds its full state record (record.json) so a
+    torn state.json can fall back to the newest VERIFIED record.  The two
+    newest records are retained (fallback depth); older superseded model
+    directories are pruned."""
     import json
-    import os
     import shutil
 
     from photon_ml_tpu.models.io import save_game_model
 
+    faults.fire("checkpoint.write", iteration=iteration)
     try:
         with open(os.path.join(directory, "state.json")) as f:
             prev = json.load(f)
@@ -271,6 +391,7 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
         else:
             best_path = os.path.join(directory, f"best-{iteration:04d}")
             save_game_model(best_model, best_path)
+            _write_manifest(best_path)
     state = {"completed_iterations": iteration + 1,
              "model_dir": path,
              "best_model_dir": best_path,
@@ -278,26 +399,51 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
              "config_fingerprint": fingerprint,
              "objective_history": objective_history,
              "validation_history": validation_history}
+    # self-contained fallback record: directory references by BASENAME so
+    # the record stays valid wherever the checkpoint directory lives
+    record = dict(state,
+                  model_dir=os.path.basename(path),
+                  best_model_dir=(os.path.basename(best_path)
+                                  if best_path else None))
+    with open(os.path.join(path, "record.json"), "w") as f:
+        json.dump(record, f, indent=1)
+    _write_manifest(path)  # seals the iter dir (covers record.json)
+
+    # retention of TWO records: remember the predecessor so resume can fall
+    # back past a record whose files turn out corrupt
+    state["previous"] = (
+        {k: prev.get(k) for k in ("completed_iterations", "model_dir",
+                                  "best_model_dir")}
+        if prev is not None else None)
     tmp = os.path.join(directory, "state.json.tmp")
     with open(tmp, "w") as f:
         json.dump(state, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    # a "kill" injected here is the canonical torn checkpoint: the new
+    # record is complete + sealed, state.json still points at the old one,
+    # and state.json.tmp is left for resume to prune
+    faults.fire("checkpoint.fsync", iteration=iteration)
     os.replace(tmp, os.path.join(directory, "state.json"))
-    # prune the dirs the superseded record referenced (only the latest
-    # record is ever resumed from); a foreign/corrupt state.json may point
-    # anywhere, so only delete paths contained in the checkpoint directory
-    if prev is not None:
-        root = os.path.realpath(directory)
-        for key in ("model_dir", "best_model_dir"):
-            old = prev.get(key)
-            if not old or old in (path, best_path) or not os.path.isdir(old):
-                continue
-            real = os.path.realpath(old)
-            if os.path.commonpath([root, real]) != root or real == root:
-                logger.warning(
-                    "checkpoint state referenced %s outside the checkpoint "
-                    "directory %s; refusing to prune it", old, directory)
-                continue
-            shutil.rmtree(real, ignore_errors=True)
+    _fsync_dir(directory)
+    # prune the dirs the GRANDPARENT record referenced (two newest records
+    # are retained); a foreign/corrupt state.json may point anywhere, so
+    # only delete paths contained in the checkpoint directory
+    grand = (prev or {}).get("previous") or {}
+    keep = {p for p in (path, best_path, (prev or {}).get("model_dir"),
+                        (prev or {}).get("best_model_dir")) if p}
+    root = os.path.realpath(directory)
+    for key in ("model_dir", "best_model_dir"):
+        old = grand.get(key)
+        if not old or old in keep or not os.path.isdir(old):
+            continue
+        real = os.path.realpath(old)
+        if os.path.commonpath([root, real]) != root or real == root:
+            logger.warning(
+                "checkpoint state referenced %s outside the checkpoint "
+                "directory %s; refusing to prune it", old, directory)
+            continue
+        shutil.rmtree(real, ignore_errors=True)
     logger.info("checkpoint: iteration %d saved to %s", iteration, path)
 
 
@@ -352,7 +498,8 @@ class AsyncCheckpointer:
             if self._error is not None:
                 err, self._error = self._error, None
                 raise RuntimeError(
-                    "async checkpoint write failed") from err
+                    "async checkpoint write failed in the background "
+                    "writer") from err
             if self._closed:
                 raise RuntimeError("AsyncCheckpointer already shut down")
             if self._pending is not None:
@@ -383,7 +530,10 @@ class AsyncCheckpointer:
 
     def shutdown(self, raise_errors: bool = True) -> None:
         """Drain the queue (the final snapshot always writes), stop the
-        worker, and re-raise any worker failure."""
+        worker, and re-raise any worker failure IMMEDIATELY — the final
+        fit-end record is part of the fit's durability contract, so a
+        failed write surfaces here (original exception as __cause__),
+        never silently.  Idempotent: a second call is a no-op."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
@@ -392,45 +542,92 @@ class AsyncCheckpointer:
         self._thread.join()
         if raise_errors and self._error is not None:
             err, self._error = self._error, None
-            raise RuntimeError("async checkpoint write failed") from err
+            raise RuntimeError(
+                "async checkpoint write failed: the final fit-end record "
+                "did not persist") from err
 
 
-def read_checkpoint(directory: str,
-                    fingerprint: Optional[str] = None
-                    ) -> Optional[CheckpointState]:
-    """The resume half of the checkpoint flow.  An unreadable or partial
-    state file is treated as no-checkpoint (the write path replaces
-    state.json atomically, so this only happens for foreign/corrupt
-    files — better to retrain than to crash the job permanently).
+def _prune_stale_tmp(directory: str) -> List[str]:
+    """Remove *.tmp files a kill-during-write left behind (state.json.tmp,
+    manifest.json.tmp, ...) — a stale tmp must never make the directory
+    look foreign or half-written on resume."""
+    pruned = []
+    if not os.path.isdir(directory):
+        return pruned
+    for root, _, names in os.walk(directory):
+        for fn in names:
+            if fn.endswith(".tmp"):
+                p = os.path.join(root, fn)
+                try:
+                    os.remove(p)
+                    pruned.append(p)
+                except OSError:
+                    pass
+    if pruned:
+        logger.warning("checkpoint at %s: pruned %d stale tmp file(s) left "
+                       "by an interrupted write: %s", directory, len(pruned),
+                       pruned)
+    return pruned
 
-    `fingerprint` guards against resuming under a CHANGED configuration: a
-    record written with a different coordinate/optimization config (outer
-    iteration count excluded — raising it is the legitimate resume use) is
-    rejected with a warning rather than silently returning a model trained
-    under different settings."""
-    import json
-    import os
+
+def _checkpoint_record_dirs(directory: str):
+    """iter-*/best-* subdirectories, newest first."""
+    out = []
+    for fn in os.listdir(directory):
+        if fn.startswith(("iter-", "best-")):
+            p = os.path.join(directory, fn)
+            if os.path.isdir(p):
+                out.append(p)
+    return sorted(out, reverse=True)
+
+
+def _prune_orphan_dirs(directory: str, keep: set) -> List[str]:
+    """Remove iter-*/best-* directories that are partial writes: not
+    referenced by the record being resumed and lacking a VERIFYING
+    manifest.  Verified-but-unreferenced directories (e.g. a record sealed
+    right before a kill-at-fsync) are kept — they are complete and will be
+    overwritten by the re-run of their iteration."""
+    import shutil
+    pruned = []
+    for p in _checkpoint_record_dirs(directory):
+        if os.path.realpath(p) in keep:
+            continue
+        ok, reason = verify_checkpoint_dir(p)
+        if ok is True:
+            continue
+        shutil.rmtree(p, ignore_errors=True)
+        pruned.append(p)
+        logger.warning("checkpoint at %s: pruned orphaned partial write %s "
+                       "(%s)", directory, p, reason)
+    return pruned
+
+
+def _state_to_checkpoint(directory: str, state: dict, relative: bool,
+                         recovery: dict) -> Optional[CheckpointState]:
+    """Load the models a (top-level or embedded) state record references.
+    `relative` resolves model/best dirs against the checkpoint directory
+    (embedded record.json stores basenames)."""
     import zipfile
 
     from photon_ml_tpu.models.io import load_game_model
 
-    state_path = os.path.join(directory, "state.json")
+    def resolve(p):
+        return os.path.join(directory, p) if relative else p
+
     try:
-        with open(state_path) as f:
-            state = json.load(f)
-        recorded = state.get("config_fingerprint")
-        if fingerprint is not None and recorded is not None \
-                and recorded != fingerprint:
-            logger.warning(
-                "checkpoint at %s was written under a different training "
-                "configuration (fingerprint %s != %s); starting fresh",
-                directory, recorded, fingerprint)
-            return None
-        model, _ = load_game_model(state["model_dir"])
+        model, _ = load_game_model(resolve(state["model_dir"]))
         best = None
         if state.get("best_model_dir"):
-            best_model, _ = load_game_model(state["best_model_dir"])
-            best = dict(best_model.coordinates)
+            best_dir = resolve(state["best_model_dir"])
+            ok, reason = verify_checkpoint_dir(best_dir)
+            if ok is False:
+                logger.warning(
+                    "checkpoint best-model directory %s failed verification "
+                    "(%s); resuming without best-model restoration",
+                    best_dir, reason)
+            else:
+                best_model, _ = load_game_model(best_dir)
+                best = dict(best_model.coordinates)
         return CheckpointState(
             completed_iterations=int(state["completed_iterations"]),
             initial_models=dict(model.coordinates),
@@ -438,12 +635,135 @@ def read_checkpoint(directory: str,
             validation_history={k: list(v) for k, v in
                                 state.get("validation_history", {}).items()},
             best_models=best,
-            best_metric=state.get("best_metric"))
+            best_metric=state.get("best_metric"),
+            recovery=recovery)
     except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
-        if os.path.exists(state_path):
-            logger.warning("checkpoint at %s unreadable (%s); starting fresh",
-                           directory, e)
+        logger.warning("checkpoint record in %s unreadable (%s)",
+                       directory, e)
         return None
+
+
+def _fingerprint_mismatch(state: dict, fingerprint: Optional[str],
+                          directory: str) -> bool:
+    recorded = state.get("config_fingerprint")
+    if fingerprint is not None and recorded is not None \
+            and recorded != fingerprint:
+        logger.warning(
+            "checkpoint at %s was written under a different training "
+            "configuration (fingerprint %s != %s); starting fresh",
+            directory, recorded, fingerprint)
+        return True
+    return False
+
+
+def read_checkpoint(directory: str,
+                    fingerprint: Optional[str] = None
+                    ) -> Optional[CheckpointState]:
+    """The resume half of the checkpoint flow, fault-contained:
+
+      1. prune stale *.tmp files left by a kill-during-write;
+      2. resume from state.json IF its model directories verify against
+         their size+checksum manifests (a legacy record without manifests
+         is accepted with a warning);
+      3. otherwise FALL BACK to the newest iter-* directory whose manifest
+         verifies, using its embedded self-contained record.json — and
+         prune orphaned partial writes (no/failing manifest, unreferenced);
+      4. otherwise: no checkpoint (better to retrain than to crash the job
+         permanently).
+
+    `model.load` is an injection site (utils/faults.py) so resume failures
+    are testable.  `fingerprint` guards against resuming under a CHANGED
+    configuration: a record written with a different coordinate/
+    optimization config (outer iteration count excluded — raising it is
+    the legitimate resume use) is rejected with a warning rather than
+    silently returning a model trained under different settings."""
+    import json
+
+    state_path = os.path.join(directory, "state.json")
+    if not os.path.isdir(directory):
+        return None
+    pruned = _prune_stale_tmp(directory)
+
+    state = None
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except OSError:
+        state = None  # no checkpoint yet (or unreadable): try fallback
+    except ValueError as e:
+        logger.warning("checkpoint at %s unreadable (%s); trying verified "
+                       "fallback", directory, e)
+        state = None
+
+    if state is not None:
+        if _fingerprint_mismatch(state, fingerprint, directory):
+            return None
+        ok, reason = verify_checkpoint_dir(state.get("model_dir") or "")
+        if ok is None:
+            logger.info("checkpoint at %s carries no manifest (legacy "
+                        "record); resuming unverified", directory)
+        if ok is not False:
+            result = _state_to_checkpoint(
+                directory, state, relative=False,
+                recovery={"fallback": False, "pruned": pruned,
+                          "resumed_from_iteration":
+                              int(state.get("completed_iterations", 0)) - 1})
+            if result is not None:
+                keep = {os.path.realpath(p) for p in
+                        (state.get("model_dir"), state.get("best_model_dir"),
+                         *(((state.get("previous") or {}).get(k)) for k in
+                           ("model_dir", "best_model_dir")))
+                        if p}
+                result.recovery["pruned"] += _prune_orphan_dirs(directory,
+                                                                keep)
+                return result
+            logger.warning("checkpoint at %s: primary record unusable; "
+                           "trying verified fallback", directory)
+        else:
+            logger.warning(
+                "checkpoint at %s: model directory %s failed manifest "
+                "verification (%s); trying verified fallback", directory,
+                state.get("model_dir"), reason)
+
+    # fallback: newest iter-* directory with a verifying manifest + an
+    # embedded record
+    for p in _checkpoint_record_dirs(directory):
+        if not os.path.basename(p).startswith("iter-"):
+            continue
+        ok, _ = verify_checkpoint_dir(p)
+        if ok is not True:
+            continue
+        record_path = os.path.join(p, "record.json")
+        try:
+            with open(record_path) as f:
+                record = json.load(f)
+        except (OSError, ValueError):
+            continue  # pre-record layout: cannot self-resume
+        if _fingerprint_mismatch(record, fingerprint, directory):
+            return None
+        result = _state_to_checkpoint(
+            directory, record, relative=True,
+            recovery={"fallback": True, "pruned": pruned,
+                      "resumed_from_iteration":
+                          int(record.get("completed_iterations", 0)) - 1})
+        if result is None:
+            continue
+        keep = {os.path.realpath(p)}
+        if record.get("best_model_dir"):
+            keep.add(os.path.realpath(
+                os.path.join(directory, record["best_model_dir"])))
+        result.recovery["pruned"] += _prune_orphan_dirs(directory, keep)
+        logger.warning(
+            "checkpoint at %s: fell back to verified record %s "
+            "(completed_iterations=%d)", directory, os.path.basename(p),
+            result.completed_iterations)
+        return result
+
+    if state is not None or _checkpoint_record_dirs(directory):
+        logger.warning("checkpoint at %s has no verifiable record; "
+                       "starting fresh", directory)
+        _prune_orphan_dirs(directory, set())
+    return None
 
 
 def run_coordinate_descent(
@@ -642,21 +962,100 @@ def run_coordinate_descent(
     # pipelined mode: per-update records awaiting the boundary readback
     # (device scalars + a models snapshot for deferred best tracking)
     pending: List[dict] = []
+    # non-finite solve quarantine (game/quarantine.py): the device-side
+    # where-guard already rolled back any NaN/Inf solve the moment it
+    # happened; the monitor applies the host-side policy (one tightened
+    # retry, else freeze) when the health flags land
+    monitor = quarantine_mod.QuarantineMonitor()
+
+    def _host_rollback(name: str, prev_model) -> None:
+        """Rare path: finite coefficients but a non-finite objective (data
+        term overflow).  The device-side guard passed the model through,
+        so roll the coordinate back on the host and recompute its score."""
+        nonlocal total
+        coord = coordinates[name]
+        if residency is not None:
+            residency.before_update(name)
+        models[name] = prev_model
+        sc = coord.score(prev_model)
+        total = (total - scores[name]) + sc
+        scores[name] = sc
+        reg_terms[name] = coord.regularization_term(prev_model)
+        if residency is not None:
+            residency.after_update(name)
+
+    def _quarantine_rerun(it: int, name: str) -> bool:
+        """The ONE tightened-budget retry after a rollback, run at the
+        point the divergence is discovered (the outer-iteration boundary
+        in pipelined mode).  Its small health readback is fine — this is
+        the rare containment path, not the hot loop."""
+        nonlocal total
+        from photon_ml_tpu.optim.schedule import QuarantineRetrySchedule
+        coord = coordinates[name]
+        if residency is not None:
+            residency.before_update(name)
+        partial = total - scores[name]
+        new_model, _tracker = coord.update(
+            models[name], base_offsets + partial,
+            schedule=QuarantineRetrySchedule(), outer_iteration=it,
+            num_outer_iterations=num_iterations)
+        guarded, flag = quarantine_mod.guard(new_model, models[name])
+        sc = coord.score(guarded)
+        new_total = partial + sc
+        old_reg = reg_terms[name]
+        reg_terms[name] = coord.regularization_term(guarded)
+        obj_dev = objective_device(new_total)
+        ok_dev = quarantine_mod.combine_health(flag, obj_dev)
+        ok_v, obj_v = jax.device_get([ok_dev, obj_dev])
+        ok = bool(ok_v)
+        if ok:
+            models[name] = guarded
+            scores[name] = sc
+            total = new_total
+            monitor.on_retry_result(it, name, True, float(obj_v))
+        else:
+            reg_terms[name] = old_reg
+            monitor.on_retry_result(it, name, False)
+        if residency is not None:
+            residency.after_update(name)
+        return ok
+
+    def _contain(it: int, name: str) -> str:
+        """Apply the quarantine policy once an unhealthy flag lands on the
+        host; returns the containment label for the visit's tracker."""
+        decision = monitor.on_divergence(it, name)
+        if decision == "retry":
+            return "retry_ok" if _quarantine_rerun(it, name) else "frozen"
+        return "frozen"
 
     def flush_pending() -> None:
-        """ONE batched device_get for every objective + metric scalar of
-        the outer iteration, then the deferred host bookkeeping (history
-        appends, tracker summaries, best-model tracking, logging)."""
+        """ONE batched device_get for every objective + metric + HEALTH
+        scalar of the outer iteration, then the deferred host bookkeeping
+        (history appends, tracker summaries, best-model tracking, logging,
+        quarantine containment)."""
         nonlocal best_metric, best_model
         if not pending:
             return
         fetched = jax.device_get(
-            [[p["objective"], list(p["metrics"].values())] for p in pending])
-        for p, (obj, metric_vals) in zip(pending, fetched):
+            [[p["objective"], p["health"], list(p["metrics"].values())]
+             for p in pending])
+        divergent = []
+        for p, (obj, health, metric_vals) in zip(pending, fetched):
             obj = float(obj)
+            healthy = bool(health)
+            key = f"{p['it']}/{p['name']}"
+            if not healthy:
+                if not math.isfinite(obj):
+                    # finite coefficients, non-finite objective: host-side
+                    # rollback, and log the pre-update objective instead
+                    _host_rollback(p["name"], p["prev_model"])
+                    obj = float(objective_device(total))
+                divergent.append(p)
             objective_history.append(obj)
-            trackers[f"{p['it']}/{p['name']}"] = _summarize_tracker(
+            trackers[key] = _summarize_tracker(
                 p["tracker"], spans[p["solve_key"]], p["budget"])
+            trackers[key].containment = ("rolled_back" if not healthy
+                                         else p["containment"])
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
                         p["it"], p["name"], obj, spans[p["solve_key"]])
             for k, (spec, v) in enumerate(zip(validation_specs, metric_vals)):
@@ -664,46 +1063,91 @@ def run_coordinate_descent(
                 validation_history[spec.name].append(v)
                 logger.info("  validation %-24s = %.6g", spec.name, v)
                 if k == 0:  # best FULL model by first evaluator (ref 294-335)
-                    if best_metric is None or \
-                            spec.evaluator.better_than(v, best_metric):
+                    if healthy and (best_metric is None or
+                                    spec.evaluator.better_than(v,
+                                                               best_metric)):
                         best_metric = v
                         best_model = GameModel(dict(p["models"]), task_type)
         pending.clear()
+        # containment AFTER the iteration's bookkeeping: the retry runs at
+        # the boundary, not inside any one entry's slot in the history
+        for p in divergent:
+            label = _contain(p["it"], p["name"])
+            trackers[f"{p['it']}/{p['name']}"].containment = label
 
     checkpointer: Optional[AsyncCheckpointer] = None
+
+    def _preempt(completed: int):
+        """Graceful-preemption exit: the in-flight coordinate update is
+        finished, make the newest checkpoint record durable, then raise
+        the distinct resumable signal (cli.train maps it to exit 75)."""
+        nonlocal checkpointer
+        logger.warning("graceful preemption: stopping after %d completed "
+                       "outer iteration(s)", completed)
+        if checkpointer is not None:
+            with spans.span("checkpoint/join"):
+                checkpointer.shutdown(raise_errors=True)
+            checkpointer = None
+        raise faults.Preempted(
+            completed, checkpoint_dir is not None and completed > 0,
+            checkpoint_dir)
+
     loop_ok = False
     try:
         for it in range(start_iteration, num_iterations):
             for name in updating_sequence:
                 solve_key = f"{it}/{name}/solve"
+                coord = coordinates[name]
+                frozen = monitor.is_frozen(name)
+                prev_model = models[name]
                 sched = (solver_schedules or {}).get(name)
                 budget_diag = None
-                if sched is not None:
+                tracker = None
+                health_flag = None
+                if sched is not None and not frozen:
                     base = coordinates[name].config.optimization \
                         .optimizer.resolved()
                     budget_diag = sched.plan(it, num_iterations,
                                              base.max_iterations,
                                              base.tolerance)
                 with spans.span(solve_key):
-                    coord = coordinates[name]
-                    if residency is not None:
-                        residency.before_update(name)
-                    if name in cold_factored:
-                        # first visit of a cold factored coordinate: seed
-                        # the latent factors from the sibling plain-RE
-                        # solution (updated earlier in this sequence pass)
-                        cold_factored.discard(name)
-                        warm = coord.warm_start_latent(models[name], models)
-                        if warm is not None:
-                            models[name] = warm
-                    # partial = full - own (reference line 186-193)
-                    partial = total - scores[name]
-                    models[name], tracker = coord.update(
-                        models[name], base_offsets + partial,
-                        schedule=sched, outer_iteration=it,
-                        num_outer_iterations=num_iterations)
-                    scores[name] = coord.score(models[name])
-                    total = partial + scores[name]
+                    if frozen:
+                        # quarantined after repeated divergence: the
+                        # coordinate keeps its last good coefficients and
+                        # the rest of the descent continues
+                        pass
+                    else:
+                        if residency is not None:
+                            residency.before_update(name)
+                        if name in cold_factored:
+                            # first visit of a cold factored coordinate:
+                            # seed the latent factors from the sibling
+                            # plain-RE solution (updated earlier in this
+                            # sequence pass)
+                            cold_factored.discard(name)
+                            warm = coord.warm_start_latent(models[name],
+                                                           models)
+                            if warm is not None:
+                                models[name] = warm
+                                prev_model = warm
+                        # partial = full - own (reference line 186-193)
+                        partial = total - scores[name]
+                        new_model, tracker = coord.update(
+                            models[name], base_offsets + partial,
+                            schedule=sched, outer_iteration=it,
+                            num_outer_iterations=num_iterations)
+                        if faults.fire("solve.poison", coordinate=name,
+                                       iteration=it) == "poison":
+                            new_model = quarantine_mod.poison_model(
+                                new_model)
+                        # device-side containment: a non-finite solve rolls
+                        # back to the last good coefficients RIGHT HERE, so
+                        # downstream coordinates never see poisoned scores;
+                        # the flag rides the batched boundary fetch
+                        models[name], health_flag = quarantine_mod.guard(
+                            new_model, prev_model)
+                        scores[name] = coord.score(models[name])
+                        total = partial + scores[name]
                     if not pipelined:
                         spans.add_blocked(solve_key, _sync(total))
                 if not pipelined:
@@ -711,16 +1155,31 @@ def run_coordinate_descent(
                     # per-update sync pipelined mode defers to the flush
                     trackers[f"{it}/{name}"] = _summarize_tracker(
                         tracker, spans[solve_key], budget_diag)
+                    if frozen:
+                        trackers[f"{it}/{name}"].containment = "frozen"
 
                 obj_key = f"{it}/{name}/objective"
                 with spans.span(obj_key):
-                    reg_terms[name] = coord.regularization_term(models[name])
+                    if not frozen:
+                        reg_terms[name] = coord.regularization_term(
+                            models[name])
                     obj_dev = objective_device(total)
+                    health_dev = (True if health_flag is None else
+                                  quarantine_mod.combine_health(health_flag,
+                                                                obj_dev))
                     if not pipelined:
                         t0 = time.perf_counter()
                         obj = float(obj_dev)
                         spans.add_blocked(obj_key, time.perf_counter() - t0)
                 if not pipelined:
+                    healthy = (health_dev is True
+                               or bool(jax.device_get(health_dev)))
+                    if not healthy:
+                        if not math.isfinite(obj):
+                            _host_rollback(name, prev_model)
+                            obj = float(objective_device(total))
+                        label = _contain(it, name)
+                        trackers[f"{it}/{name}"].containment = label
                     objective_history.append(obj)
                     logger.info("iter %d coordinate %-16s objective=%.8g "
                                 "(%.2fs)", it, name, obj, spans[solve_key])
@@ -779,7 +1238,22 @@ def run_coordinate_descent(
                                     "objective": obj_dev, "metrics": metrics,
                                     "models": dict(models),
                                     "tracker": tracker,
-                                    "budget": budget_diag})
+                                    "budget": budget_diag,
+                                    "health": health_dev,
+                                    "prev_model": prev_model,
+                                    "containment": ("frozen" if frozen
+                                                    else None)})
+
+                if faults.preemption_requested() \
+                        and name != updating_sequence[-1]:
+                    # the in-flight coordinate update is DONE; settle the
+                    # iteration's device scalars, then exit resumably (the
+                    # newest durable record covers the completed
+                    # iterations — this partial iteration retrains)
+                    if pipelined:
+                        with spans.span(f"{it}/flush", host_blocked=True):
+                            flush_pending()
+                    _preempt(it)
 
             if pipelined:
                 # outer-iteration boundary: the ONE host sync of the
@@ -805,6 +1279,11 @@ def run_coordinate_descent(
                                           validation_history,
                                           best_model, best_metric,
                                           checkpoint_fingerprint)
+
+            if faults.preemption_requested():
+                # iteration boundary: this iteration's record is submitted
+                # (pipelined) or already on disk (strict) — drain and exit
+                _preempt(it + 1)
         loop_ok = True
     finally:
         if checkpointer is not None:
@@ -841,4 +1320,6 @@ def run_coordinate_descent(
         model=final, best_model=best_model,
         objective_history=objective_history,
         validation_history=validation_history, timings=spans,
-        trackers=trackers)
+        trackers=trackers,
+        containment_events=monitor.events,
+        frozen_coordinates=monitor.frozen)

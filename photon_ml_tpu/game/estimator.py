@@ -56,6 +56,10 @@ class GameResult:
     # per-coordinate block bytes, eviction count, tracked peak — the
     # memory_stats() stand-in bench --stream and the peak-memory test read
     residency: Optional[dict] = None
+    # how the fit's checkpoint was recovered at resume time (CheckpointState
+    # .recovery: fallback flag, pruned partial writes, resumed iteration);
+    # None when the fit started fresh or checkpointing was off
+    checkpoint_recovery: Optional[dict] = None
 
 
 class GameEstimator:
@@ -237,7 +241,10 @@ class GameEstimator:
                           objective_history=descent.objective_history,
                           validation=validation, descent=descent,
                           validation_specs=specs,
-                          residency=residency.accounting())
+                          residency=residency.accounting(),
+                          checkpoint_recovery=(resume.recovery
+                                               if resume is not None
+                                               else None))
 
     def fit_grid(
         self,

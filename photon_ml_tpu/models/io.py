@@ -64,7 +64,13 @@ def save_game_model(
     entity (original feature space, name.term keys), LatentFactorAvro for
     matrix factorization — a model the Spark implementation can read.
     Factored random effects materialize to per-entity original-space models
-    on Avro save (the reference persists original-space models too)."""
+    on Avro save (the reference persists original-space models too).
+
+    `model.save` is a fault-injection site (utils/faults.py): chaos runs
+    inject write failures here to prove checkpointing surfaces them."""
+    from photon_ml_tpu.utils import faults
+    faults.fire("model.save", directory=os.path.basename(
+        directory.rstrip("/")))
     if format == "avro":
         return _save_game_model_avro(model, directory, config, index_maps)
     if format == "reference":
@@ -608,7 +614,13 @@ def load_game_model(directory: str
     Accepts this package's npz and Avro layouts AND a model directory the
     Scala reference itself wrote (part-*.avro partition files + the
     reference's own model-metadata.json, or no metadata at all for
-    pre-metadata models)."""
+    pre-metadata models).
+
+    `model.load` is a fault-injection site (utils/faults.py): chaos runs
+    inject read failures here to prove resume falls back cleanly."""
+    from photon_ml_tpu.utils import faults
+    faults.fire("model.load", directory=os.path.basename(
+        directory.rstrip("/")))
     meta_p = os.path.join(directory, "model-metadata.json")
     if not os.path.exists(meta_p):
         if _is_reference_layout(directory):

@@ -2,7 +2,9 @@ from photon_ml_tpu.optim.config import (  # noqa: F401
     OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType, solve,
 )
 from photon_ml_tpu.optim.lbfgs import lbfgs, owlqn  # noqa: F401
-from photon_ml_tpu.optim.schedule import SolveBudget, SolverSchedule  # noqa: F401
+from photon_ml_tpu.optim.schedule import (  # noqa: F401
+    QuarantineRetrySchedule, SolveBudget, SolverSchedule,
+)
 from photon_ml_tpu.optim.streaming import (  # noqa: F401
     host_lbfgs, host_owlqn, host_tron, solve_streamed,
 )

@@ -119,3 +119,30 @@ class SolverSchedule:
             iteration_growth=d.get("iteration_growth", 2.0),
             initial_tolerance_factor=d.get("initial_tolerance_factor", 1e3),
             tolerance_decay=d.get("tolerance_decay", 0.1))
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineRetrySchedule:
+    """Schedule-shaped single-solve budget for quarantine re-runs (GAME
+    non-finite solve containment, game/quarantine.py): a diverged
+    quasi-Newton solve is usually a line-search/curvature pathology that
+    more iterations make WORSE, so the one retry runs at a quarter of the
+    configured iteration cap with a 10x looser tolerance — conservative
+    steps, early stop.  Duck-types SolverSchedule's `plan`/`budget_for` so
+    it rides the existing Coordinate.update(schedule=...) plumbing without
+    new solver parameters (and therefore without new traces)."""
+
+    cap_divisor: int = 4
+    tolerance_factor: float = 10.0
+
+    def plan(self, outer_iteration: int, num_outer_iterations: int,
+             max_iterations: int, tolerance: float) -> Tuple[int, float]:
+        return (max(1, max_iterations // self.cap_divisor),
+                tolerance * self.tolerance_factor)
+
+    def budget_for(self, outer_iteration: int, num_outer_iterations: int,
+                   optimizer_config) -> SolveBudget:
+        r = optimizer_config.resolved()
+        cap, tol = self.plan(outer_iteration, num_outer_iterations,
+                             r.max_iterations, r.tolerance)
+        return SolveBudget.make(cap, tol)

@@ -3,3 +3,7 @@ from photon_ml_tpu.utils.events import (  # noqa: F401
     Event, EventEmitter, EventListener, LoggingEventListener,
     OptimizationLogEvent, SetupEvent, TrainingFinishEvent, TrainingStartEvent,
 )
+from photon_ml_tpu.utils.faults import (  # noqa: F401
+    EXIT_PREEMPTED, FatalFault, FaultPlan, FaultSpec, GracefulPreemption,
+    Preempted, TransientFault, is_transient,
+)

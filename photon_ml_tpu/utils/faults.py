@@ -1,0 +1,347 @@
+"""Deterministic fault injection + graceful preemption: the containment
+layer's control plane.
+
+The reference Photon ML inherited fault tolerance for free from Spark's
+lineage-based recovery (GLMix, KDD'16); a JAX rebuild has to build its own —
+and a failure path that is never exercised is a failure path that does not
+work.  This module makes faults FIRST-CLASS and REPRODUCIBLE:
+
+  * `FaultPlan` / `FaultSpec` — a seeded registry of named injection sites
+    (trigger by exact hit index or by seeded probability, optionally
+    filtered on call context like the coordinate name or chunk index).
+    Activated per-process via `install_plan` / the `injected` context
+    manager, or across process boundaries via the `PHOTON_FAULT_PLAN`
+    environment variable (inline JSON or `@file`) — which is how the
+    bench's kill-resume chaos leg arms its subprocess children.
+  * `fire(site, **ctx)` — the hook threaded through chunk staging, device
+    transfer, checkpoint write/fsync, and model save/load.  With no plan
+    installed it is a module-global None check and return: a zero-overhead
+    no-op on every hot path (the compile-count and pipelined-timing smokes
+    gate this).
+  * transient-vs-fatal classification (`is_transient`) shared by the
+    streaming Prefetcher's retry loop.
+  * `GracefulPreemption` — SIGTERM/SIGINT handling for preemptible pools:
+    first signal requests a graceful stop (the descent loop finishes the
+    in-flight coordinate update, makes the newest checkpoint durable, and
+    raises `Preempted`); a second signal escalates to KeyboardInterrupt.
+    `cli.train` maps `Preempted` to the distinct resumable exit status
+    `EXIT_PREEMPTED` (75, EX_TEMPFAIL — "transient failure, retry").
+
+Injection sites currently threaded (ctx keys in parentheses):
+
+  stage.fetch       chunk staging host read        (chunk)
+  stage.transfer    chunk host->device transfer    (chunk)
+  checkpoint.write  checkpoint record write start  (iteration)
+  checkpoint.fsync  after state.json.tmp fsync,    (iteration)
+                    before the atomic rename — a "kill" here is the
+                    canonical torn-checkpoint crash test
+  model.save        save_game_model entry          (directory)
+  model.load        load_game_model entry          (directory)
+  solve.poison      after a coordinate solve       (coordinate, iteration)
+                    — action "poison" corrupts the solve result with NaNs
+                    instead of raising, exercising the quarantine path
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import signal
+import threading
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger("photon_ml_tpu")
+
+#: Distinct resumable exit status for graceful preemption (EX_TEMPFAIL).
+EXIT_PREEMPTED = 75
+
+
+class FaultError(Exception):
+    """Base class of injected faults."""
+
+
+class TransientFault(FaultError):
+    """An injected fault the retry machinery is expected to absorb."""
+
+    transient = True
+
+
+class FatalFault(FaultError):
+    """An injected fault that must NOT be retried (propagates and kills
+    the operation, like a permission error or corrupted input would)."""
+
+    transient = False
+
+
+# exception types the streaming retry loop treats as retryable; anything
+# else — and always KeyboardInterrupt/SystemExit/MemoryError/FatalFault —
+# propagates immediately
+TRANSIENT_EXCEPTIONS = (TransientFault, ConnectionError, TimeoutError,
+                        OSError)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient-vs-fatal classification for retry loops: an explicit
+    `transient` attribute wins, then the type table above.  Interrupts and
+    memory exhaustion are never transient."""
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, MemoryError)):
+        return False
+    flagged = getattr(exc, "transient", None)
+    if flagged is not None:
+        return bool(flagged)
+    return isinstance(exc, TRANSIENT_EXCEPTIONS)
+
+
+_ACTIONS = ("transient", "fatal", "kill", "sigterm", "poison")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One arming rule: WHERE (site + context match), WHEN (1-based hit
+    indices, or a seeded probability with an optional fire cap), WHAT
+    (action).  Counters live on the spec so a plan is also its own
+    report."""
+
+    site: str
+    action: str = "transient"
+    hits: Tuple[int, ...] = ()          # 1-based matching-call indices
+    probability: float = 0.0            # alternative to hits (seeded RNG)
+    max_fires: Optional[int] = None     # cap for probability mode
+    match: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # runtime counters (not part of the JSON identity)
+    calls: int = dataclasses.field(default=0, compare=False)
+    fired: int = dataclasses.field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r} "
+                             f"(expected one of {_ACTIONS})")
+        if not self.hits and not self.probability:
+            raise ValueError(f"fault spec for site {self.site!r} never "
+                             "fires: give hits=[...] or probability>0")
+        self.hits = tuple(int(h) for h in self.hits)
+
+    def matches(self, ctx: Dict[str, object]) -> bool:
+        return all(str(ctx.get(k)) == str(v) for k, v in self.match.items())
+
+    def to_dict(self) -> dict:
+        d = {"site": self.site, "action": self.action}
+        if self.hits:
+            d["hits"] = list(self.hits)
+        if self.probability:
+            d["probability"] = self.probability
+        if self.max_fires is not None:
+            d["max_fires"] = self.max_fires
+        if self.match:
+            d["match"] = dict(self.match)
+        return d
+
+
+class FaultPlan:
+    """A seeded set of FaultSpecs + firing state.  Thread-safe: sites fire
+    from the staging thread and the training thread concurrently."""
+
+    def __init__(self, specs, seed: int = 0):
+        self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
+                      for s in specs]
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+
+    # -- JSON round-trip (PHOTON_FAULT_PLAN / --fault-plan) ----------------
+    @staticmethod
+    def from_dict(d: dict) -> "FaultPlan":
+        return FaultPlan(d.get("faults", []), seed=d.get("seed", 0))
+
+    @staticmethod
+    def from_json(text: str) -> "FaultPlan":
+        return FaultPlan.from_dict(json.loads(text))
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed,
+                "faults": [s.to_dict() for s in self.specs]}
+
+    def report(self) -> dict:
+        """Per-site calls/fired accounting (the bench records this per
+        chaos leg)."""
+        sites: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for s in self.specs:
+                agg = sites.setdefault(s.site, {"calls": 0, "fired": 0})
+                agg["calls"] += s.calls
+                agg["fired"] += s.fired
+            total = sum(s.fired for s in self.specs)
+        return {"sites": sites, "total_fired": total}
+
+    def _decide(self, site: str, ctx: Dict[str, object]) -> Optional[str]:
+        with self._lock:
+            for s in self.specs:
+                if s.site != site or not s.matches(ctx):
+                    continue
+                s.calls += 1
+                fire_now = (s.calls in s.hits if s.hits else
+                            (s.max_fires is None or s.fired < s.max_fires)
+                            and self._rng.random() < s.probability)
+                if fire_now:
+                    s.fired += 1
+                    return s.action
+        return None
+
+    def fire(self, site: str, **ctx) -> Optional[str]:
+        action = self._decide(site, ctx)
+        if action is None:
+            return None
+        logger.warning("fault injection: site=%s ctx=%s action=%s",
+                       site, ctx, action)
+        if action == "transient":
+            raise TransientFault(f"injected transient fault at {site!r} "
+                                 f"(ctx {ctx})")
+        if action == "fatal":
+            raise FatalFault(f"injected fatal fault at {site!r} (ctx {ctx})")
+        if action == "kill":
+            # the crash test: an abrupt, unhandleable death mid-operation
+            os.kill(os.getpid(), signal.SIGKILL)
+        if action == "sigterm":
+            # graceful-preemption test: delivered to our own handler
+            os.kill(os.getpid(), signal.SIGTERM)
+            return None
+        return action  # "poison": caller applies the corruption
+
+
+# -- process-global activation ------------------------------------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or clear, with None) the process-global plan; returns the
+    previous one."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, plan
+    return prev
+
+
+class injected:
+    """Context manager: `with faults.injected(plan): ...` — scoped
+    activation for tests and in-process bench legs."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        self._prev = install_plan(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc):
+        install_plan(self._prev)
+
+
+def install_from_env(env_var: str = "PHOTON_FAULT_PLAN"
+                     ) -> Optional[FaultPlan]:
+    """Arm the plan named by the environment (inline JSON, or `@path`):
+    how subprocess children of the chaos bench — and preempted re-launches
+    of cli.train — pick up their injection plan."""
+    raw = os.environ.get(env_var)
+    if not raw:
+        return None
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    plan = FaultPlan.from_json(raw)
+    install_plan(plan)
+    logger.warning("fault plan ACTIVE from $%s: %d spec(s), seed %d",
+                   env_var, len(plan.specs), plan.seed)
+    return plan
+
+
+def fire(site: str, **ctx) -> Optional[str]:
+    """The injection hook.  MUST stay zero-overhead when no plan is
+    installed — it sits on chunk staging and checkpoint hot paths."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan.fire(site, **ctx)
+
+
+# -- graceful preemption ------------------------------------------------------
+
+class Preempted(RuntimeError):
+    """Raised by the descent loop after a graceful-preemption request has
+    been honored: the in-flight coordinate update finished and the newest
+    checkpoint record is durable.  cli.train maps this to EXIT_PREEMPTED."""
+
+    def __init__(self, completed_iterations: int, checkpointed: bool,
+                 checkpoint_dir: Optional[str] = None):
+        self.completed_iterations = completed_iterations
+        self.checkpointed = checkpointed
+        self.checkpoint_dir = checkpoint_dir
+        super().__init__(
+            f"training preempted after {completed_iterations} completed "
+            f"outer iteration(s); "
+            + (f"resumable from checkpoint {checkpoint_dir!r}"
+               if checkpointed else "no durable checkpoint was written"))
+
+
+_PREEMPT = threading.Event()
+
+
+def preemption_requested() -> bool:
+    return _PREEMPT.is_set()
+
+
+def request_preemption() -> None:
+    """Programmatic preemption (tests; also what the SIGTERM handler
+    does)."""
+    _PREEMPT.set()
+
+
+def clear_preemption() -> None:
+    _PREEMPT.clear()
+
+
+class GracefulPreemption:
+    """Scope that converts SIGTERM/SIGINT into a graceful-stop request.
+
+    First signal: set the preemption flag (the descent loop notices at the
+    next coordinate boundary, finishes the in-flight update, drains the
+    checkpointer, raises Preempted).  Second signal: the operator means it
+    — raise KeyboardInterrupt immediately.  Handlers install only in the
+    main thread (signal module requirement) and are restored on exit."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = signals
+        self._old: Dict[int, object] = {}
+
+    def _handle(self, signum, frame):
+        if _PREEMPT.is_set():
+            raise KeyboardInterrupt(
+                f"second signal {signum} during graceful preemption")
+        logger.warning("signal %d: graceful preemption requested — will "
+                       "stop after the in-flight coordinate update and "
+                       "make the checkpoint durable", signum)
+        _PREEMPT.set()
+
+    def __enter__(self) -> "GracefulPreemption":
+        clear_preemption()
+        if threading.current_thread() is threading.main_thread():
+            for sig in self.signals:
+                try:
+                    self._old[sig] = signal.signal(sig, self._handle)
+                except (ValueError, OSError):  # non-main thread / exotic sig
+                    pass
+        return self
+
+    def __exit__(self, *exc):
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old.clear()
+        clear_preemption()

@@ -112,6 +112,46 @@ def test_inexact_smoke(tmp_path):
     assert "perUserMF" in mf["coordinates"]
 
 
+def test_faults_smoke(tmp_path, monkeypatch):
+    """bench.py --faults --smoke end-to-end in tier-1 (ISSUE 5 satellite):
+    the chaos harness — injected staging faults absorbed by retry/backoff,
+    SIGKILL mid-checkpoint-fsync recovered by the manifest-verified resume,
+    a poisoned coordinate quarantined and re-run — cannot rot without
+    failing the normal test run.  Every leg is parity-gated against its
+    fault-free trajectory at the 1e-4 gate."""
+    monkeypatch.setenv("PHOTON_JAX_CACHE", str(tmp_path / "jaxcache"))
+    bench = _load_bench()
+    out = tmp_path / "BENCH_faults.json"
+    result = bench.faults_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_parity_ok"] is True
+    assert result["value"] <= 1e-4
+
+    staging = next(e for e in detail["entries"] if "staging" in e["name"])
+    assert staging["retries"] >= 4 and staging["gave_up"] == 0
+    assert staging["injected"]["total_fired"] >= 4
+    assert staging["objective_history_max_abs_gap"] == 0.0
+
+    kill = next(e for e in detail["entries"] if "kill" in e["name"])
+    assert kill["killed_returncode"] not in (0, 1)  # actually SIGKILLed
+    assert kill["stale_tmp_left_by_kill"] is True
+    assert kill["pruned_on_resume"] >= 1
+    assert kill["objective_history_max_rel_gap"] <= kill["parity_gate"]
+
+    poisoned = next(e for e in detail["entries"] if "poison" in e["name"])
+    actions = [ev["action"] for ev in poisoned["containment_events"]]
+    assert "rolled_back" in actions
+    assert poisoned["history_finite"] is True
+    assert poisoned["final_rel_gap_vs_fault_free"] <= \
+        poisoned["parity_gate"]
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a
