@@ -160,10 +160,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "scratch)")
     p.add_argument("--hbm-budget", default=None,
                    help="device-memory residency budget, e.g. '8GB', "
-                        "'512MB', or raw bytes.  When the training "
+                        "'512MB', or raw bytes — PER DEVICE on a mesh "
+                        "(blocks shard 1/D per chip, so aggregate fit size "
+                        "scales with fleet HBM).  When the training "
                         "coordinates' device blocks can't all fit: "
                         "fixed-effect shards over budget stream in double-"
-                        "buffered host->device chunks, and inactive "
+                        "buffered host->device chunks (sharded over the "
+                        "mesh when one is active), and inactive "
                         "coordinates' blocks are evicted between "
                         "coordinate-descent visits (out-of-core training — "
                         "fit size bounded by host memory, not HBM; see "
@@ -674,9 +677,16 @@ def _run(args, log) -> int:
                              else None),
             "wall_s": round(time.time() - t0, 2),
             "timing_mode": args.timing_mode,
-            # HBM residency accounting (None budget = unbounded/resident)
+            # HBM residency accounting (None budget = unbounded/resident;
+            # PER-DEVICE semantics on a mesh — accounting carries
+            # per_device/data_devices)
             "hbm_budget_bytes": hbm_budget,
             "hbm_residency": getattr(best, "residency", None),
+            # multi-chip accounting: mesh axes + cold/warm staged bytes
+            # (mesh_transfer proves a warm iteration moves only
+            # coefficients/offsets, never the dataset)
+            "mesh": dict(mesh.shape) if mesh is not None else None,
+            "mesh_transfer": getattr(best, "mesh_transfer", None),
             "host_blocked_s": round(
                 getattr(getattr(best.descent, "timings", None),
                         "host_blocked_total", lambda: 0.0)(), 3),
@@ -692,6 +702,16 @@ def _run(args, log) -> int:
             log.info("solver %-16s solves=%d iterations=%d reasons=%s "
                      "caps=%s", coord, d["solves"], d["iterations"],
                      d["reasons"], d["iteration_caps"])
+        if mesh is not None and summary["mesh_transfer"] is not None:
+            acct = summary["hbm_residency"] or {}
+            log.info(
+                "mesh %s: staged %.1f MB cold / %.1f MB warm; per-device "
+                "peak %.1f MB (budget %s)", dict(mesh.shape),
+                summary["mesh_transfer"]["cold_bytes"] / 1e6,
+                summary["mesh_transfer"]["warm_bytes"] / 1e6,
+                acct.get("peak_tracked_bytes", 0) / 1e6,
+                ("%.1f MB" % (acct["budget_bytes"] / 1e6)
+                 if acct.get("budget_bytes") else "unbounded"))
         for name, t in getattr(best.descent, "timings", {}).items():
             log.info("phase %s: %.3fs", name, t)
         print(json.dumps(summary))

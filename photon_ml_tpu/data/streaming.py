@@ -109,7 +109,8 @@ class ChunkPlan:
     @staticmethod
     def build(num_rows: int, *, chunk_rows: Optional[int] = None,
               hbm_budget_bytes: Optional[int] = None,
-              bytes_per_row: Optional[int] = None) -> "ChunkPlan":
+              bytes_per_row: Optional[int] = None,
+              row_multiple: int = 1) -> "ChunkPlan":
         """Partition `num_rows` rows.
 
         Either pass `chunk_rows` (rounded up to a power of two) or a device
@@ -118,9 +119,17 @@ class ChunkPlan:
         `bytes_per_row`.  A chunk covering every row degenerates to a
         single-chunk plan — the streamed oracle then matches the resident
         one bit-for-bit (tests rely on this).
+
+        `row_multiple` additionally rounds every padded chunk size up to a
+        multiple (the mesh data-axis size, so each staged chunk shards
+        evenly over the devices).  The ≤2-compiled-shapes property is
+        preserved: full chunks share one rounded size, the tail gets its
+        own.
         """
         if num_rows < 1:
             raise ValueError(f"num_rows must be >= 1, got {num_rows}")
+        if row_multiple < 1:
+            raise ValueError(f"row_multiple must be >= 1, got {row_multiple}")
         if chunk_rows is None:
             if hbm_budget_bytes is None or bytes_per_row is None:
                 raise ValueError("pass chunk_rows, or hbm_budget_bytes with "
@@ -129,14 +138,18 @@ class ChunkPlan:
             chunk_rows = ceil_pow2(per_chunk)
             if chunk_rows > per_chunk:        # ceil overshot the budget
                 chunk_rows //= 2
+        mult = int(row_multiple)
+        ceil_mult = lambda v: -(-int(v) // mult) * mult
         chunk_rows = int(ceil_pow2(max(int(chunk_rows), MIN_CHUNK_ROWS)))
         chunk_rows = min(chunk_rows, int(ceil_pow2(num_rows)))
+        chunk_rows = ceil_mult(chunk_rows)
         chunks = []
         start = 0
         while start < num_rows:
             stop = min(start + chunk_rows, num_rows)
             rows = stop - start
-            padded = chunk_rows if rows == chunk_rows else int(ceil_pow2(rows))
+            padded = (chunk_rows if rows == chunk_rows
+                      else min(ceil_mult(ceil_pow2(rows)), chunk_rows))
             chunks.append(ChunkSpec(index=len(chunks), start=start, stop=stop,
                                     padded_rows=padded))
             start = stop
@@ -254,7 +267,8 @@ class Prefetcher:
     def __init__(self, plan: ChunkPlan, fetch: Callable[[ChunkSpec], object],
                  depth: int = 2, stats: Optional[StreamStats] = None,
                  max_attempts: int = STAGE_MAX_ATTEMPTS,
-                 backoff_s: float = STAGE_BACKOFF_S):
+                 backoff_s: float = STAGE_BACKOFF_S,
+                 transfer: Optional[Callable[[object], object]] = None):
         if depth < 2:
             # the producer stages chunk k only after the consumer has taken
             # chunk k-depth+1, so depth 1 would deadlock before chunk 0
@@ -267,6 +281,10 @@ class Prefetcher:
         self.max_attempts = max_attempts
         self.backoff_s = backoff_s
         self.stats = stats if stats is not None else StreamStats()
+        # host pytree -> device placement; the default is an unsharded
+        # jnp.asarray transfer — mesh consumers (ops/chunked.py) pass a
+        # data-sharded device_put so each chunk lands split over the mesh
+        self._transfer = transfer if transfer is not None else _tree_device_put
 
     def _stage_with_retry(self, spec: ChunkSpec, jitter: random.Random):
         """fetch + device transfer for one chunk, absorbing transient
@@ -278,7 +296,7 @@ class Prefetcher:
                 faults.fire("stage.fetch", chunk=spec.index)
                 host = self.fetch(spec)
                 faults.fire("stage.transfer", chunk=spec.index)
-                return _tree_device_put(host)
+                return self._transfer(host)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:

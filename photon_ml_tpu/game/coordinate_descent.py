@@ -145,6 +145,13 @@ class TrackerSummary:
     iteration_cap: Optional[int] = None
     tolerance: Optional[float] = None
     containment: Optional[str] = None
+    # mesh transfer bytes staged during THIS visit ({"cold": b, "warm": b},
+    # parallel/mesh_residency.py TransferStats delta): cold = static
+    # coordinate data (first visit / post-eviction re-stream), warm =
+    # per-visit operands (offsets, x0).  A warm steady-state mesh visit
+    # must stage ZERO cold bytes — bench --mesh and the transfer
+    # regression test gate on this.  None on non-mesh fits.
+    staged_bytes: Optional[Dict[str, int]] = None
 
 
 def _reason_counts(reason) -> Dict[str, int]:
@@ -231,6 +238,11 @@ class CoordinateDescentResult:
                     d["containment"].get(t.containment, 0) + 1
             for name, c in t.reasons.items():
                 d["reasons"][name] = d["reasons"].get(name, 0) + c
+            if t.staged_bytes is not None:
+                sb = d.setdefault("staged_bytes",
+                                  {"cold": 0, "warm": 0})
+                sb["cold"] += t.staged_bytes.get("cold", 0)
+                sb["warm"] += t.staged_bytes.get("warm", 0)
         return out
 
 
@@ -826,6 +838,23 @@ def run_coordinate_descent(
                          f"got {timing_mode!r}")
     pipelined = timing_mode == "pipelined"
     loss = TASK_LOSSES[task_type]
+    # mesh transfer accounting (parallel/mesh_residency.py): per-visit
+    # staged-bytes deltas (cold static data vs warm offsets/x0) land in the
+    # tracker summaries, making the mesh path's no-retransfer property
+    # observable per update.  Counters are host-side ints — snapshotting
+    # them never syncs the device.
+    _mesh_snap = None
+    if any(getattr(getattr(c, "mesh", None), "size", 1) > 1
+           for c in coordinates.values()):
+        from photon_ml_tpu.parallel.mesh_residency import transfer_snapshot
+        _mesh_snap = transfer_snapshot
+
+    def _staged_delta(before):
+        if before is None:
+            return None
+        after = _mesh_snap()
+        return {"cold": after["cold_bytes"] - before["cold_bytes"],
+                "warm": after["warm_bytes"] - before["warm_bytes"]}
     spans = PhaseTimings() if timings is None else timings
     with spans.span("init/transfer"):
         labels = jnp.asarray(dataset.response)
@@ -1056,6 +1085,7 @@ def run_coordinate_descent(
                 p["tracker"], spans[p["solve_key"]], p["budget"])
             trackers[key].containment = ("rolled_back" if not healthy
                                          else p["containment"])
+            trackers[key].staged_bytes = p["staged"]
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
                         p["it"], p["name"], obj, spans[p["solve_key"]])
             for k, (spec, v) in enumerate(zip(validation_specs, metric_vals)):
@@ -1100,6 +1130,7 @@ def run_coordinate_descent(
                 coord = coordinates[name]
                 frozen = monitor.is_frozen(name)
                 prev_model = models[name]
+                mesh_before = _mesh_snap() if _mesh_snap else None
                 sched = (solver_schedules or {}).get(name)
                 budget_diag = None
                 tracker = None
@@ -1232,6 +1263,9 @@ def run_coordinate_descent(
                     # queue-safe — XLA keeps buffers alive until in-flight
                     # consumers finish.
                     residency.after_update(name)
+                staged = _staged_delta(mesh_before)
+                if not pipelined and staged is not None:
+                    trackers[f"{it}/{name}"].staged_bytes = staged
                 if pipelined:
                     pending.append({"it": it, "name": name,
                                     "solve_key": solve_key,
@@ -1241,6 +1275,7 @@ def run_coordinate_descent(
                                     "budget": budget_diag,
                                     "health": health_dev,
                                     "prev_model": prev_model,
+                                    "staged": staged,
                                     "containment": ("frozen" if frozen
                                                     else None)})
 

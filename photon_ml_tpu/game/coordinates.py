@@ -94,27 +94,30 @@ class FixedEffectCoordinate:
         self._canonical = jnp.dtype(jax.dtypes.canonicalize_dtype(
             host_x.dtype if is_dense else np.float64))
         shard_bytes = self._resident_shard_bytes(host_x)
+        # mesh data-axis width: resident blocks shard 1/D per device, so
+        # budgets (per-device semantics) compare against shard_bytes / D
+        self._data_div = 1
+        if mesh is not None:
+            from photon_ml_tpu.parallel.mesh import DATA_AXIS
+            self._data_div = max(int(mesh.shape.get(DATA_AXIS, 1)), 1)
 
         # --- memory-mode resolution -----------------------------------------
         if config.memory_mode == "streamed":
             self.streamed = True
         elif config.memory_mode == "resident":
             self.streamed = False
-        else:  # auto
+        else:  # auto: stream iff the PER-DEVICE resident footprint busts
+            # half the per-device budget (the other half stays for RE
+            # blocks, flat vectors and accumulators)
             self.streamed = (hbm_budget_bytes is not None and is_dense
-                             and mesh is None
-                             and shard_bytes > hbm_budget_bytes // 2)
+                             and shard_bytes // self._data_div
+                             > hbm_budget_bytes // 2)
         if self.streamed:
             if not is_dense:
                 raise ValueError(
                     f"coordinate {name!r}: memory_mode='streamed' requires a "
                     "dense host shard (chunking a sparse matrix would re-pack "
                     "ELL per chunk per pass); use the resident sparse path")
-            if mesh is not None and mesh.size > 1:
-                raise ValueError(
-                    f"coordinate {name!r}: memory_mode='streamed' targets a "
-                    "single HBM-bound device; use the mesh-sharded resident "
-                    "path for multi-chip fits")
             if config.optimization.downsampling_rate is not None:
                 raise ValueError(
                     f"coordinate {name!r}: downsampling is not supported in "
@@ -164,30 +167,46 @@ class FixedEffectCoordinate:
             n = host_x.shape[0]
             row_bytes = (self.dim + 4) * self._canonical.itemsize
             if config.chunk_rows is not None:
-                plan = ChunkPlan.build(n, chunk_rows=config.chunk_rows)
+                plan = ChunkPlan.build(n, chunk_rows=config.chunk_rows,
+                                       row_multiple=self._data_div)
             elif hbm_budget_bytes is not None:
-                # two chunks fit in the coordinate's half of the budget
+                # two chunks fit in the coordinate's half of the budget;
+                # on a mesh the budget is per device and each chunk shards
+                # 1/D per device, so the aggregate chunk budget scales by D
                 plan = ChunkPlan.build(
-                    n, hbm_budget_bytes=hbm_budget_bytes // 2,
-                    bytes_per_row=row_bytes)
+                    n,
+                    hbm_budget_bytes=(hbm_budget_bytes // 2) * self._data_div,
+                    bytes_per_row=row_bytes, row_multiple=self._data_div)
             else:
-                plan = ChunkPlan.build(n, chunk_rows=max(n // 8, 1))
+                plan = ChunkPlan.build(n, chunk_rows=max(n // 8, 1),
+                                       row_multiple=self._data_div)
             cast = lambda a: (None if a is None else
                               np.asarray(a, dtype=self._canonical))
             # ONE persistent chunked objective: per-update residual offsets
             # swap in via replace() (prefetcher stats accumulate across the
-            # fit for the bench's transfer accounting)
+            # fit for the bench's transfer accounting).  Under a mesh each
+            # staged chunk shards rows over the "data" axis and GSPMD
+            # inserts the accumulation psums.
             self._stream = ChunkedGLMObjective(
                 self.loss, cast(host_x), cast(dataset.response), plan,
-                weights=cast(dataset.weights), norm=self.norm)
+                weights=cast(dataset.weights), norm=self.norm,
+                mesh=mesh if self._data_div > 1 else None)
             # a stale full device copy from an earlier consumer would defeat
             # the budget — streaming stages chunks from the host copy
             dataset.release_device_shard(config.feature_shard)
         elif hbm_budget_bytes is None:
             # no budget: materialize eagerly, exactly the pre-out-of-core
             # behavior (transfer cost lands in build/coordinates, not in the
-            # first solve span)
-            self.x  # noqa: B018 — property materializes the device copy
+            # first solve span).  The mesh path stages its padded + sharded
+            # copy into the residency layer instead of a full single-device
+            # copy.
+            if self._data_div > 1:
+                from photon_ml_tpu.parallel.fixed_effect import (
+                    staged_fixed_effect_x)
+                staged_fixed_effect_x(self._mesh_key(), self.mesh,
+                                      self._mesh_x_source())
+            else:
+                self.x  # noqa: B018 — property materializes the device copy
 
     # --- device residency -----------------------------------------------------
     def _resident_shard_bytes(self, host_x) -> int:
@@ -202,6 +221,22 @@ class FixedEffectCoordinate:
         itemsize = jnp.dtype(jax.dtypes.canonicalize_dtype(
             host_x.dtype)).itemsize
         return int(host_x.shape[0]) * k * (4 + itemsize)
+
+    def _mesh_key(self):
+        """Residency key of this coordinate's staged mesh arrays (the
+        per-coordinate invalidation unit, parallel/mesh_residency.py)."""
+        return (self.name, id(self))
+
+    def _mesh_x_source(self):
+        """Identity-stable source the mesh residency layer stages the
+        design matrix from.  A dense host shard stages DIRECTLY host ->
+        sharded devices (no intermediate full single-device copy); sparse
+        or host-released shards go through the shared device FeatureMatrix
+        (`self.x`)."""
+        host = self._dataset.feature_shards[self.config.feature_shard]
+        if isinstance(host, np.ndarray):
+            return host
+        return self.x
 
     @property
     def x(self):
@@ -243,11 +278,16 @@ class FixedEffectCoordinate:
 
     def evict_device_blocks(self) -> None:
         """Residency-manager hook: drop the device shard between visits
-        (no-op when streamed — nothing is pinned)."""
+        (no-op when streamed — nothing is pinned).  The mesh path drops
+        ONLY this coordinate's staged sharded arrays (per-coordinate
+        invalidation; other coordinates' entries stay resident)."""
         if self.streamed:
             return
         self._x = None
         self._dataset.release_device_shard(self.config.feature_shard)
+        if self._data_div > 1:
+            from photon_ml_tpu.parallel.mesh_residency import invalidate
+            invalidate(self._mesh_key())
 
     def initial_model(self) -> FixedEffectModel:
         """reference: Coordinate.initializeModel — zero coefficients.
@@ -297,17 +337,26 @@ class FixedEffectCoordinate:
             keep, weights = downsampler_for_task(self.task_type)(
                 sub, self.labels, self.weights, opt.downsampling_rate)
             weights = weights * keep
-        obj = GLMObjective(self.loss, self.x, self.labels, weights=weights,
-                           offsets=offsets, norm=self.norm)
         x0 = model.glm.coefficients.means
         if self.norm is not None:
             x0 = self.norm.model_to_transformed_space(x0)
         if self.mesh is not None:
+            # mesh-resident path: the objective's static arrays stage ONCE
+            # per coordinate through the residency layer (dense host shards
+            # stage straight into their sharded layout — no intermediate
+            # full-device copy); a warm visit moves only offsets and x0
+            obj = GLMObjective(self.loss, self._mesh_x_source(), self.labels,
+                               weights=weights, offsets=offsets,
+                               norm=self.norm)
             res = fit_fixed_effect(obj, x0, self.mesh, opt.optimizer,
                                    opt.regularization, opt.regularization_weight,
                                    shard_features=self.shard_features,
-                                   budget=budget)
+                                   budget=budget,
+                                   residency_key=self._mesh_key())
         else:
+            obj = GLMObjective(self.loss, self.x, self.labels,
+                               weights=weights, offsets=offsets,
+                               norm=self.norm)
             if x0 is model.glm.coefficients.means:
                 # the solver donates x0 (in-place buffer reuse); the model's
                 # live coefficients may still be referenced by best-model /
@@ -328,9 +377,21 @@ class FixedEffectCoordinate:
     def score(self, model: FixedEffectModel) -> jax.Array:
         """Margin contribution on the TRAINING data, canonical order.
         Streamed mode computes it chunk-by-chunk and returns ONE device [n]
-        array — the flat residual-score vectors stay resident either way."""
+        array — the flat residual-score vectors stay resident either way.
+        The mesh path scores through the SAME staged sharded design matrix
+        the update used (one residency entry per coordinate): rescoring
+        moves no data, and scores come back sharded over "data"."""
         if self.streamed:
             return self._stream.scores(model.glm.coefficients.means)
+        if self._data_div > 1:
+            from photon_ml_tpu.parallel.fixed_effect import (
+                _cached_scorer, staged_fixed_effect_x)
+            n, x_dev = staged_fixed_effect_x(self._mesh_key(), self.mesh,
+                                             self._mesh_x_source())
+            with self.mesh:
+                scores = _cached_scorer()(model.glm.coefficients.means,
+                                          x_dev, None)
+            return scores[:n]
         return fops.matvec(self.x, model.glm.coefficients.means)
 
     def regularization_term(self, model: FixedEffectModel) -> jax.Array:
@@ -425,20 +486,28 @@ class _EntityCoordinateBase:
     def streaming_buffer_bytes(self) -> int:
         return 0
 
+    def _mesh_key(self):
+        """Residency key prefix of this coordinate's staged mesh arrays
+        (buckets append their lane start; factored coordinates append
+        "latent"/"kron" — all invalidate together via prefix match)."""
+        return (self.name, id(self))
+
     def evict_device_blocks(self) -> None:
         """Residency-manager hook: drop this coordinate's device blocks
         (per-entity buckets, flat shard view, projection).  Safe mid-queue:
         XLA keeps buffers alive until in-flight consumers finish; the next
-        visit's lazy accessors re-stream from the host copies."""
+        visit's lazy accessors re-stream from the host copies.  The
+        mesh-path invalidation is PER COORDINATE: only THIS coordinate's
+        staged padded/sharded blocks drop from the residency layer — the
+        old `clear_mesh_block_cache()` call here dropped every
+        coordinate's memoized blocks on any eviction."""
         self.red.evict_device_blocks()
         self._flat_x = None
         self._proj_dev = None
         self._dataset.release_device_shard(self.config.feature_shard)
         if self.mesh is not None:
-            # the mesh-path memo pins padded/sharded copies of the blocks
-            from photon_ml_tpu.parallel.random_effect import (
-                clear_mesh_block_cache)
-            clear_mesh_block_cache()
+            from photon_ml_tpu.parallel.mesh_residency import invalidate
+            invalidate(self._mesh_key())
 
     def _score_model(self, model) -> jax.Array:
         """All rows (active AND passive) scored against their entity's model
@@ -516,7 +585,8 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
                 blocks, self.loss, self.mesh, x0=x0,
                 config=opt.optimizer, reg=opt.regularization,
                 reg_weight=opt.regularization_weight, donate_buffers=True,
-                budget=budget)
+                budget=budget,
+                cache_key=(*self._mesh_key(), bucket.lane_start))
             results.append(res_b)
         res = (results[0] if len(results) == 1 else jax.tree_util.tree_map(
             lambda *a: jnp.concatenate(a, axis=0), *results))
@@ -649,7 +719,8 @@ class FactoredRandomEffectCoordinate(_EntityCoordinateBase):
             latent_config=lat.optimizer, latent_reg=lat.regularization,
             latent_reg_weight=lat.regularization_weight,
             latent_row_weights_fn=latent_row_weights_fn,
-            re_budget=re_budget, latent_budget=latent_budget)
+            re_budget=re_budget, latent_budget=latent_budget,
+            cache_key=self._mesh_key())
         new_model = dataclasses.replace(
             model, latent_coefficients=res.latent_coefficients,
             projection=res.projection)

@@ -60,6 +60,12 @@ class GameResult:
     # .recovery: fallback flag, pruned partial writes, resumed iteration);
     # None when the fit started fresh or checkpointing was off
     checkpoint_recovery: Optional[dict] = None
+    # mesh transfer accounting over this fit (TransferStats delta from
+    # parallel/mesh_residency.py): bytes staged cold (static coordinate
+    # data, once per residency) vs warm (per-visit offsets/x0) — the
+    # observable no-retransfer property bench --mesh gates.  None when the
+    # fit ran without a multi-device mesh.
+    mesh_transfer: Optional[dict] = None
 
 
 class GameEstimator:
@@ -128,7 +134,7 @@ class GameEstimator:
         flat += sum(4 * n for c in self.config.coordinates.values()
                     if hasattr(c, "random_effect_type"))
         return ResidencyManager(coords, self.config.hbm_budget_bytes,
-                                flat_vector_bytes=flat)
+                                flat_vector_bytes=flat, mesh=self.mesh)
 
     def _config_fingerprint(
             self, evaluator_specs: Optional[Sequence[str]]) -> str:
@@ -197,6 +203,12 @@ class GameEstimator:
             self.emitter.send_event(TrainingStartEvent(time.time()))
         from photon_ml_tpu.game.coordinate_descent import PhaseTimings
         spans = PhaseTimings()
+        # snapshot BEFORE the build: eager mesh staging of FE shards happens
+        # inside _build_coordinates and belongs to this fit's cold bytes
+        mesh_snap0 = None
+        if self.mesh is not None and self.mesh.size > 1:
+            from photon_ml_tpu.parallel.mesh_residency import transfer_snapshot
+            mesh_snap0 = transfer_snapshot()
         # coordinate construction includes the RE dataset bucketing — a real
         # cost at corpus scale that round 3's phase timings never saw
         with spans.span("build/coordinates"):
@@ -237,6 +249,12 @@ class GameEstimator:
                 objective_history=list(descent.objective_history),
                 final_metrics=dict(validation)))
             self.emitter.send_event(TrainingFinishEvent(time.time()))
+        mesh_transfer = None
+        if mesh_snap0 is not None:
+            from photon_ml_tpu.parallel.mesh_residency import (
+                TransferStats, transfer_snapshot)
+            mesh_transfer = TransferStats.delta(mesh_snap0,
+                                                transfer_snapshot())
         return GameResult(model=descent.best_model, config=self.config,
                           objective_history=descent.objective_history,
                           validation=validation, descent=descent,
@@ -244,7 +262,8 @@ class GameEstimator:
                           residency=residency.accounting(),
                           checkpoint_recovery=(resume.recovery
                                                if resume is not None
-                                               else None))
+                                               else None),
+                          mesh_transfer=mesh_transfer)
 
     def fit_grid(
         self,

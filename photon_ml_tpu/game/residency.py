@@ -17,14 +17,23 @@ describes (arXiv:1803.06333):
      device blocks are EVICTED and re-streamed on its next visit (host
      copies kept by the out-of-core build, keep_host_blocks).
 
-The manager also keeps the transfer-size accounting (`peak_tracked_bytes`)
-that stands in for device.memory_stats() on backends without it — bench
---stream and the peak-memory test consume it.
+On a device mesh the budget is PER DEVICE: coordinate blocks shard their
+leading axis over the mesh "data" axis, so each device holds 1/D of every
+block and the manager accounts block bytes divided by D (flat [n] vectors
+are counted undivided — conservative, they may stay replicated).  Fit size
+then scales with AGGREGATE fleet HBM: the same budget admits D times the
+data on a D-chip mesh.
+
+The manager also keeps the transfer-size accounting (`peak_tracked_bytes`,
+per-device when a mesh is present) that stands in for
+device.memory_stats() on backends without it — bench --stream / --mesh and
+the peak-memory tests consume it.
 """
 from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 from typing import Dict, Optional
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -50,18 +59,28 @@ class ResidencyManager:
 
     def __init__(self, coordinates: Dict[str, object],
                  budget_bytes: Optional[int],
-                 flat_vector_bytes: int = 0):
+                 flat_vector_bytes: int = 0,
+                 mesh=None):
         self.budget_bytes = budget_bytes
         self.flat_vector_bytes = flat_vector_bytes
+        # per-device accounting divisor: blocks shard their leading axis
+        # over the mesh "data" axis, so each device carries 1/D of every
+        # block; the budget is interpreted PER DEVICE
+        self.data_devices = 1
+        if mesh is not None:
+            from photon_ml_tpu.parallel.mesh import DATA_AXIS
+            self.data_devices = max(int(mesh.shape.get(DATA_AXIS, 1)), 1)
+        per_dev = lambda b: int(math.ceil(b / self.data_devices))
         self.footprints: Dict[str, CoordinateFootprint] = {}
         self._coords = coordinates
         for name, coord in coordinates.items():
             streamed = bool(getattr(coord, "streamed", False))
             self.footprints[name] = CoordinateFootprint(
                 name=name,
-                block_bytes=0 if streamed else int(coord.device_block_bytes()),
+                block_bytes=(0 if streamed
+                             else per_dev(int(coord.device_block_bytes()))),
                 streamed=streamed,
-                chunk_bytes=(int(coord.streaming_buffer_bytes())
+                chunk_bytes=(per_dev(int(coord.streaming_buffer_bytes()))
                              if streamed else 0))
         self.resident_block_total = sum(f.block_bytes
                                         for f in self.footprints.values())
@@ -82,10 +101,12 @@ class ResidencyManager:
         self.evictions = 0
         if self.evict_inactive:
             logger.info(
-                "hbm budget %.0f MB < resident coordinate blocks %.0f MB "
+                "hbm budget %.0f MB%s < resident coordinate blocks %.0f MB "
                 "(+%.0f MB flat vectors): rotating residency — inactive "
                 "coordinates evict after their update and re-stream on the "
                 "next visit", budget_bytes / 1e6,
+                (" per device (%d-way data mesh)" % self.data_devices
+                 if self.data_devices > 1 else ""),
                 self.resident_block_total / 1e6, flat_vector_bytes / 1e6)
 
     # -- descent-loop hooks ---------------------------------------------------
@@ -120,6 +141,8 @@ class ResidencyManager:
         stand-in for device.memory_stats() where that API is missing."""
         return {
             "budget_bytes": self.budget_bytes,
+            "per_device": self.data_devices > 1,
+            "data_devices": self.data_devices,
             "flat_vector_bytes": self.flat_vector_bytes,
             "resident_block_bytes": {
                 n: f.block_bytes for n, f in self.footprints.items()

@@ -48,7 +48,12 @@ class FixedEffectModel:
         x = dataset.device_shard(self.feature_shard)
         if mesh is not None:
             from photon_ml_tpu.parallel.fixed_effect import score_fixed_effect
-            return score_fixed_effect(self.glm, x, mesh)
+            # key the staged sharded design matrix per (dataset, shard):
+            # repeated rescoring (every coordinate update touches the
+            # validation set) re-transfers nothing
+            return score_fixed_effect(
+                self.glm, x, mesh,
+                residency_key=("score", id(dataset), self.feature_shard))
         return self.glm.compute_score(x)
 
     def summary(self) -> str:
@@ -139,7 +144,9 @@ class RandomEffectModel:
         x = dataset.device_shard(self.feature_shard)
         lanes = self._device_lanes(dataset)
         if mesh is not None:
-            n, (x, lanes) = _sharded_rows(mesh, x, lanes)
+            n, (x, lanes) = _sharded_rows(
+                mesh, x, lanes,
+                residency_key=("score", id(dataset), self.feature_shard))
             return score_by_entity(self.global_coefficients(), x, lanes)[:n]
         # single fused program per shape (projection + gather + dot): over a
         # tunneled device each op-by-op program pays an executable upload

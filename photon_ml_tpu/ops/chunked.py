@@ -118,6 +118,12 @@ class ChunkedGLMObjective:
     l2_weight: jax.Array | float = 0.0
     stats: StreamStats = dataclasses.field(default_factory=StreamStats)
     prefetch_depth: int = 2
+    # device mesh for multi-chip streaming: each staged chunk is placed
+    # with its rows sharded over the mesh "data" axis (the ChunkPlan must
+    # be built with row_multiple = data-axis size so shards are even), and
+    # GSPMD inserts the cross-device psums inside the same accumulation
+    # kernels.  None = single-device staging, the pre-mesh behavior.
+    mesh: Optional[object] = None
 
     def __post_init__(self):
         if hasattr(self.x, "tocsr") and not isinstance(self.x, np.ndarray):
@@ -127,9 +133,38 @@ class ChunkedGLMObjective:
         if self.plan.num_rows != self.x.shape[0]:
             raise ValueError(f"plan covers {self.plan.num_rows} rows but the "
                              f"feature block has {self.x.shape[0]}")
+        transfer = None
+        if self.mesh is not None and getattr(self.mesh, "size", 1) > 1:
+            from photon_ml_tpu.parallel.mesh import DATA_AXIS
+            data_axis = int(self.mesh.shape[DATA_AXIS])
+            for spec in self.plan.chunks:
+                if spec.padded_rows % data_axis:
+                    raise ValueError(
+                        f"chunk {spec.index} pads to {spec.padded_rows} rows, "
+                        f"not a multiple of the mesh data axis {data_axis}; "
+                        "build the ChunkPlan with row_multiple=data_axis")
+            transfer = self._mesh_transfer
         self._prefetcher = Prefetcher(self.plan, self._fetch,
                                       depth=self.prefetch_depth,
-                                      stats=self.stats)
+                                      stats=self.stats, transfer=transfer)
+
+    def _mesh_transfer(self, host: dict) -> dict:
+        """Chunk host pytree -> device, rows sharded over the mesh "data"
+        axis (dtypes canonicalized exactly as the single-device
+        _tree_device_put would)."""
+        from photon_ml_tpu.parallel.mesh import data_sharding
+        canon = jax.dtypes.canonicalize_dtype
+
+        def put(a):
+            if a is None:
+                return None
+            a = np.asarray(a)
+            if a.dtype != canon(a.dtype):
+                a = np.asarray(a, dtype=canon(a.dtype))
+            return jax.device_put(a, data_sharding(self.mesh, a.ndim))
+
+        return jax.tree_util.tree_map(put, host,
+                                      is_leaf=lambda a: a is None)
 
     # -- chunk staging (host side) -------------------------------------------
     def _fetch(self, spec: ChunkSpec) -> dict:

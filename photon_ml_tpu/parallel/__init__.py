@@ -4,6 +4,10 @@ from photon_ml_tpu.parallel.mesh import (  # noqa: F401
 )
 from photon_ml_tpu.parallel.fixed_effect import (  # noqa: F401
     fit_fixed_effect, pad_batch_to_mesh, score_fixed_effect, shard_objective,
+    stage_objective,
+)
+from photon_ml_tpu.parallel.mesh_residency import (  # noqa: F401
+    MeshResidency, TransferStats, default_residency, transfer_snapshot,
 )
 from photon_ml_tpu.parallel.random_effect import (  # noqa: F401
     EntityBlocks, fit_random_effects, random_effect_variances,

@@ -117,6 +117,7 @@ def refit_latent_projection(
     reg_weight: jax.Array | float = 0.0,
     row_weights: Optional[jax.Array] = None,
     budget=None,
+    cache_key=None,
 ) -> Tuple[jax.Array, SolveResult]:
     """One projection-matrix refit: flatten the active blocks to rows, treat
     flatten(P) as the coefficient vector of a GLM over the implicit
@@ -126,23 +127,63 @@ def refit_latent_projection(
     (scala:~200-250) — there the kron rows are materialized and shuffled;
     here KroneckerDesign keeps the design implicit.  `row_weights` lets the
     caller apply down-sampling (reference: runWithSampling with the optional
-    latent sampler)."""
+    latent sampler).
+
+    On a mesh with `cache_key`, the STATIC half of the Kronecker design
+    (x rows, labels, mask — all derived from the blocks, which coordinate
+    descent keeps stable across visits) stages through the mesh residency
+    layer once; only the latent factors, offsets and P itself move per
+    visit.  Without a key the legacy whole-objective staging runs."""
     E, S, d = blocks.x.shape
     k = latent_coefficients.shape[1]
     n = E * S
-    x_flat = blocks.x.reshape(n, d)
     factors = jnp.repeat(latent_coefficients, S, axis=0)          # [n, k]
-    labels = blocks.labels.reshape(n)
-    mask = blocks.mask.reshape(n)
     weights = None if blocks.weights is None else blocks.weights.reshape(n)
     if row_weights is not None:
         weights = row_weights if weights is None else weights * row_weights
     offsets = None if blocks.offsets is None else blocks.offsets.reshape(n)
-
-    design = KroneckerDesign(x_flat, factors)
-    obj = GLMObjective(loss, design, labels, weights=weights, offsets=offsets,
-                       mask=mask)
     p0 = projection.reshape(-1)
+
+    if mesh is not None and cache_key is not None:
+        from photon_ml_tpu.parallel.mesh_residency import default_residency
+        res_reg = default_residency()
+        key = (*cache_key, "kron") if isinstance(cache_key, tuple) \
+            else (cache_key, "kron")
+        x_dev = res_reg.stage_static(key, "x", mesh, blocks.x, 0.0,
+                                     build=lambda: blocks.x.reshape(n, d))
+        labels_dev = res_reg.stage_static(
+            key, "labels", mesh, blocks.labels, 0.5,
+            build=lambda: blocks.labels.reshape(n))
+        mask_dev = res_reg.stage_static(
+            key, "mask", mesh, blocks.mask, 0.0,
+            build=lambda: blocks.mask.reshape(n))
+        if weights is None:
+            weights_dev = None
+        elif row_weights is None:
+            weights_dev = res_reg.stage_static(
+                key, "weights", mesh, blocks.weights, 0.0,
+                build=lambda: blocks.weights.reshape(n))
+        else:  # fresh sampling draw every visit: warm by definition
+            weights_dev = res_reg.stage_update(mesh, weights, 0.0, key=key,
+                                               field="weights")
+        factors_dev = res_reg.stage_update(mesh, factors, 0.0, key=key,
+                                           field="factors")
+        offsets_dev = res_reg.stage_update(mesh, offsets, 0.0, key=key,
+                                           field="offsets")
+        obj = GLMObjective(loss, KroneckerDesign(x_dev, factors_dev),
+                           labels_dev, weights=weights_dev,
+                           offsets=offsets_dev, mask=mask_dev)
+        p0_dev = res_reg.stage_update(mesh, p0, spec="replicated", key=key,
+                                      field="p0")
+        with mesh:
+            res = _cached_solver(config, reg)(
+                obj, p0_dev, jnp.asarray(reg_weight, p0.dtype), budget)
+        return res.x.reshape(k, d), res
+
+    design = KroneckerDesign(blocks.x.reshape(n, d), factors)
+    obj = GLMObjective(loss, design, blocks.labels.reshape(n),
+                       weights=weights, offsets=offsets,
+                       mask=blocks.mask.reshape(n))
     if mesh is not None:
         res = fit_fixed_effect(obj, p0, mesh, config, reg, reg_weight,
                                budget=budget)
@@ -170,6 +211,7 @@ def fit_factored_random_effects(
     latent_row_weights_fn: Optional[Callable[[int], Optional[jax.Array]]] = None,
     re_budget=None,
     latent_budget=None,
+    cache_key=None,
 ) -> FactoredSolveResult:
     """The alternation loop (reference: FactoredRandomEffectCoordinate
     .updateModel, scala:100-160): numInnerIterations rounds of
@@ -182,17 +224,22 @@ def fit_factored_random_effects(
     latent-space and projection-matrix solves respectively."""
     C, P = latent_coefficients, projection
     re_res = lat_res = None
+    latent_key = None
+    if cache_key is not None:
+        latent_key = ((*cache_key, "latent") if isinstance(cache_key, tuple)
+                      else (cache_key, "latent"))
     for it in range(num_inner_iterations):
         latent_blocks = project_blocks(blocks, P)
         re_res = fit_random_effects(latent_blocks, loss, mesh, x0=C,
                                     config=re_config, reg=re_reg,
                                     reg_weight=re_reg_weight,
-                                    budget=re_budget)
+                                    budget=re_budget, cache_key=latent_key)
         C = re_res.x
         rw = latent_row_weights_fn(it) if latent_row_weights_fn else None
         P, lat_res = refit_latent_projection(
             blocks, C, P, loss, mesh, latent_config, latent_reg,
-            latent_reg_weight, row_weights=rw, budget=latent_budget)
+            latent_reg_weight, row_weights=rw, budget=latent_budget,
+            cache_key=cache_key)
     return FactoredSolveResult(latent_coefficients=C, projection=P,
                                random_effect_result=re_res,
                                latent_result=lat_res)

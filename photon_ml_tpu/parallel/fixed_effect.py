@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.models.coefficients import Coefficients
@@ -56,6 +57,58 @@ def pad_batch_to_mesh(objective: GLMObjective, mesh: Mesh) -> GLMObjective:
         x=fops.pad_rows(objective.x, rem), labels=pad(objective.labels, 0.5),
         weights=pad(objective.weights, 0.0), offsets=pad(objective.offsets, 0.0),
         mask=pad(mask, 0.0))
+
+
+def staged_fixed_effect_x(key, mesh: Mesh, x, residency=None):
+    """Memoized padded+sharded design matrix for one coordinate: update and
+    score share ONE staged copy (keyed per coordinate), so a warm outer
+    iteration never re-transfers the feature block.  Returns (n, x_dev).
+    A CSC-carrying PaddedSparse drops its column-sorted stream first (the
+    row-interleaved order cannot shard over the data axis) — deferred into
+    the staging `build` so a cache hit never rebuilds it."""
+    from photon_ml_tpu.ops.features import PaddedSparse
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    res = residency if residency is not None else default_residency()
+    build = None
+    if isinstance(x, PaddedSparse) and x.has_csc and mesh.size > 1:
+        build = x.without_csc
+    x_dev = res.stage_static(key, "x", mesh, x, 0.0, build=build)
+    return x.shape[0], x_dev
+
+
+def stage_objective(objective: GLMObjective, mesh: Mesh, key,
+                    residency=None) -> GLMObjective:
+    """The mesh-resident replacement for `shard_objective`: the STATIC
+    arrays (design matrix, labels, weights, mask, normalization) are
+    padded + sharded ONCE per coordinate through the residency layer —
+    keyed by `key`, invalidated per coordinate — and only the residual
+    `offsets` stage per visit (counted warm by TransferStats).  Numerics
+    match `shard_objective` exactly: same pads (labels 0.5, everything
+    else 0, mask marks real rows), same shardings."""
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    res = residency if residency is not None else default_residency()
+    labels = objective.labels
+    _, x_dev = staged_fixed_effect_x(key, mesh, objective.x, residency=res)
+    labels_dev = res.stage_static(key, "labels", mesh, labels, 0.5)
+    weights_dev = res.stage_static(key, "weights", mesh, objective.weights,
+                                   0.0)
+    # mask: anchored on the mask array when one exists, else derived from
+    # the labels (ones over real rows, zero padding)
+    if objective.mask is not None:
+        mask_dev = res.stage_static(key, "mask", mesh, objective.mask, 0.0)
+    else:
+        mask_dev = res.stage_static(
+            key, "mask", mesh, labels, 0.0,
+            build=lambda: np.ones(labels.shape[0],
+                                  jax.dtypes.canonicalize_dtype(labels.dtype)))
+    norm_dev = res.stage_static(key, "norm", mesh, objective.norm,
+                                spec="replicated")
+    offsets_dev = res.stage_update(mesh, objective.offsets, 0.0, key=key,
+                                   field="offsets")
+    return objective.replace(
+        x=x_dev, labels=labels_dev, weights=weights_dev,
+        offsets=offsets_dev, mask=mask_dev, norm=norm_dev,
+        l2_weight=objective.l2_weight)
 
 
 def shard_objective(objective: GLMObjective, mesh: Mesh) -> GLMObjective:
@@ -110,13 +163,27 @@ def fit_fixed_effect(
     reg_weight: jax.Array | float = 0.0,
     shard_features: bool = False,
     budget=None,
+    residency_key=None,
 ) -> SolveResult:
     """One distributed fixed-effect solve.  Equivalent in role to
-    DistributedOptimizationProblem.run (reference line 103-121)."""
-    sharded_obj = shard_objective(objective, mesh)
-    coef_sharding = (NamedSharding(mesh, P(FEATURE_AXIS)) if shard_features
-                     else replicated(mesh))
-    x0 = jax.device_put(x0, coef_sharding)
+    DistributedOptimizationProblem.run (reference line 103-121).
+
+    With `residency_key` (the coordinate-descent path) the objective's
+    static arrays stage through the mesh residency layer: padded + sharded
+    ONCE per coordinate, so a warm visit moves only offsets and x0.
+    Without it (standalone callers) the legacy per-call `shard_objective`
+    runs."""
+    if residency_key is not None:
+        from photon_ml_tpu.parallel.mesh_residency import default_residency
+        sharded_obj = stage_objective(objective, mesh, residency_key)
+        x0 = default_residency().stage_update(
+            mesh, x0, spec="feature" if shard_features else "replicated",
+            key=residency_key, field="x0")
+    else:
+        sharded_obj = shard_objective(objective, mesh)
+        coef_sharding = (NamedSharding(mesh, P(FEATURE_AXIS))
+                         if shard_features else replicated(mesh))
+        x0 = jax.device_put(x0, coef_sharding)
     with mesh:
         return _cached_solver(config, reg)(sharded_obj, x0,
                                            jnp.asarray(reg_weight, x0.dtype),
@@ -133,17 +200,21 @@ def _cached_scorer():
 
 
 def score_fixed_effect(model: GeneralizedLinearModel, x, mesh: Mesh,
-                       offsets: Optional[jax.Array] = None) -> jax.Array:
+                       offsets: Optional[jax.Array] = None,
+                       residency_key=None) -> jax.Array:
     """Sharded margin computation (reference: FixedEffectModel scoring via
     broadcast dot product, FixedEffectCoordinate.scala:143-152).  Scores come
     back sharded over "data" — they stay device-resident for coordinate
     descent's residual exchange.  Rows are padded to a mesh multiple and the
-    padding sliced off the result."""
+    padding sliced off the result.  With `residency_key` the design matrix
+    is memoized per key in the mesh residency layer — repeated rescores of
+    the same shard re-transfer nothing."""
     from photon_ml_tpu.parallel.mesh import pad_and_shard_rows
     if offsets is None:
-        n, (x,) = pad_and_shard_rows(mesh, x)
+        n, (x,) = pad_and_shard_rows(mesh, x, residency_key=residency_key)
     else:
-        n, (x, offsets) = pad_and_shard_rows(mesh, x, offsets)
+        n, (x, offsets) = pad_and_shard_rows(mesh, x, offsets,
+                                             residency_key=residency_key)
     with mesh:
         scores = _cached_scorer()(model.coefficients.means, x, offsets)
     return scores[:n]

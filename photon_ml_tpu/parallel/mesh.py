@@ -96,27 +96,32 @@ def feature_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(FEATURE_AXIS))
 
 
-def pad_and_shard_rows(mesh: Mesh, *arrays):
+def pad_and_shard_rows(mesh: Mesh, *arrays, residency_key=None):
     """Pad row-leading arrays with zeros to a data-axis multiple and place
     them sharded over "data".  Returns (original_n, [padded arrays...]);
     callers slice results back to original_n.  The one shared implementation
     of the pad/shard/slice pattern used by distributed scoring and training
     entry points.  Accepts FeatureMatrix values (e.g. PaddedSparse) — their
-    array leaves are padded and sharded leaf-wise."""
-    from photon_ml_tpu.ops import features as fops
+    array leaves are padded and sharded leaf-wise.
+
+    Every transfer runs through the mesh residency layer's retrying stage
+    (the `mesh.stage` fault-injection site + the Prefetcher's transient/
+    fatal classification), and its bytes land in the global TransferStats.
+    With `residency_key`, the FIRST array (the design matrix — by far the
+    largest) is memoized per key: repeated scoring of the same shard
+    re-transfers nothing; the remaining arrays (offsets, per-call operands)
+    stage warm every call."""
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    res = default_residency()
     n = arrays[0].shape[0]
-    rem = (-n) % mesh.shape[DATA_AXIS]
     out = []
-    for a in arrays:
-        if isinstance(a, jax.Array) or not hasattr(a, "tree_flatten"):
-            a = jnp.asarray(a)
-            if rem:
-                a = jnp.concatenate([a, jnp.zeros((rem,) + a.shape[1:], a.dtype)])
-            out.append(jax.device_put(a, data_sharding(mesh, a.ndim)))
+    for i, a in enumerate(arrays):
+        if residency_key is not None and i == 0:
+            out.append(res.stage_static(residency_key, "rows", mesh, a, 0.0))
         else:
-            a = fops.pad_rows(a, rem)
-            out.append(jax.tree_util.tree_map(
-                lambda l: jax.device_put(l, data_sharding(mesh, np.ndim(l))), a))
+            out.append(res.stage_update(mesh, a, 0.0,
+                                        key=residency_key or "pad_and_shard",
+                                        field=f"rows{i}"))
     return n, out
 
 

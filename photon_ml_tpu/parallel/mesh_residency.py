@@ -1,0 +1,339 @@
+"""Mesh-resident coordinate data: pad + shard static arrays over the mesh ONCE.
+
+Before this layer every mesh-path coordinate visit re-padded and re-
+`device_put` its ENTIRE batch (fixed-effect objectives through
+`shard_objective`, entity blocks through the ad-hoc `_MESH_BLOCK_CACHE` in
+parallel/random_effect.py, scoring inputs through `pad_and_shard_rows`) —
+steady-state multi-chip training re-transferred the whole dataset every
+coordinate-descent visit.  The distributed coordinate descent literature
+(PAPERS.md: arXiv 1611.02101; Snap ML, arXiv 1803.06333) gets its scaling
+precisely by keeping partitions device-local and moving only coefficients
+and residuals; this module is that discipline for the GSPMD mesh path:
+
+  * `MeshResidency` memoizes each coordinate's STATIC arrays (feature
+    blocks, labels, masks, weights, normalization contexts) padded to a
+    mesh multiple and sharded over the "data" axis, keyed per coordinate
+    with explicit per-coordinate invalidation (the HBM residency manager's
+    eviction hook).  A warm outer iteration then stages only the per-visit
+    operands — residual offsets, x0 — between host and devices.
+  * `TransferStats` counts every staged byte, split COLD (static data,
+    staged once per residency) vs WARM (per-visit operands), so the
+    no-retransfer property is observable: bench --mesh and the regression
+    tests gate "zero cold bytes across warm outer iterations" on it.
+  * staging runs under the same transient/fatal fault classification as
+    the streaming Prefetcher: the `mesh.stage` injection site
+    (utils/faults.py) fires before each transfer, transient failures retry
+    with jittered exponential backoff, fatal ones propagate.
+
+Keys are tuples — typically ``(coordinate_name, id(coordinate))`` plus an
+optional sub-key (an entity bucket's lane start, "latent", "kron") — and
+`invalidate(prefix)` drops every entry whose key starts with the prefix:
+evicting one coordinate no longer drops every other coordinate's staged
+blocks (the old `clear_mesh_block_cache` sledgehammer, kept as a deprecated
+alias over `clear()`).
+"""
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.parallel.mesh import (
+    DATA_AXIS, data_sharding, feature_sharding, replicated,
+)
+from photon_ml_tpu.utils import faults
+
+# staging retry policy — mirrors data/streaming.py's Prefetcher: a flaky
+# host read / device transfer must not kill a long fit; transient failures
+# (faults.is_transient) retry with jittered exponential backoff, fatal ones
+# (and always KeyboardInterrupt/SystemExit) propagate immediately.
+STAGE_MAX_ATTEMPTS = 3
+STAGE_BACKOFF_S = 0.05
+STAGE_BACKOFF_JITTER = 0.5
+
+
+class MeshStagingError(RuntimeError):
+    """A mesh transfer failed after exhausting its retry budget (or hit a
+    fatal, non-retryable error).  The message names the residency key; the
+    original failure rides as __cause__."""
+
+
+class TransferStats:
+    """Byte accounting for mesh staging: the observable form of the
+    no-retransfer property.  COLD bytes are static coordinate data (feature
+    blocks, labels, masks) staged once per residency; WARM bytes are the
+    per-visit operands (residual offsets, x0) that legitimately move every
+    update.  Thread-safe: scoring may stage from worker threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cold_bytes = 0
+        self.warm_bytes = 0
+        self.cold_stages = 0
+        self.warm_stages = 0
+        self.invalidations = 0
+        self.evictions = 0          # FIFO capacity evictions, not eviction-API
+        self.retries = 0
+
+    def note_stage(self, nbytes: int, warm: bool) -> None:
+        with self._lock:
+            if warm:
+                self.warm_bytes += nbytes
+                self.warm_stages += 1
+            else:
+                self.cold_bytes += nbytes
+                self.cold_stages += 1
+
+    def note_invalidation(self, count: int = 1) -> None:
+        with self._lock:
+            self.invalidations += count
+
+    def note_eviction(self) -> None:
+        with self._lock:
+            self.evictions += 1
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"cold_bytes": self.cold_bytes,
+                    "warm_bytes": self.warm_bytes,
+                    "cold_stages": self.cold_stages,
+                    "warm_stages": self.warm_stages,
+                    "invalidations": self.invalidations,
+                    "evictions": self.evictions,
+                    "retries": self.retries}
+
+    @staticmethod
+    def delta(before: Dict[str, int], after: Dict[str, int]) -> Dict[str, int]:
+        return {k: after[k] - before.get(k, 0) for k in after}
+
+
+def _canonical_np(a: np.ndarray) -> np.ndarray:
+    """Host array in the dtype a plain jnp.asarray transfer would yield
+    (float64 -> float32 without x64), so staging from host numpy matches
+    the resident path's numerics exactly."""
+    want = jax.dtypes.canonicalize_dtype(a.dtype)
+    return a if a.dtype == want else np.asarray(a, dtype=want)
+
+
+def _pad_axis0(a, rem: int, fill):
+    """Append `rem` fill-rows.  Host numpy pads on the host (one sharded
+    device_put follows — no intermediate unsharded device copy); device
+    arrays pad with jnp."""
+    if rem == 0:
+        return a
+    if isinstance(a, np.ndarray):
+        out = np.empty((a.shape[0] + rem,) + a.shape[1:], a.dtype)
+        out[: a.shape[0]] = a
+        out[a.shape[0]:] = fill
+        return out
+    a = jnp.asarray(a)
+    return jnp.concatenate([a, jnp.full((rem,) + a.shape[1:], fill, a.dtype)])
+
+
+def _put_leaf(mesh, leaf, spec: str):
+    if leaf is None:
+        return None
+    if isinstance(leaf, np.ndarray):
+        leaf = _canonical_np(leaf)
+    if spec == "replicated" or np.ndim(leaf) == 0:
+        return jax.device_put(leaf, replicated(mesh))
+    if spec == "feature":
+        return jax.device_put(leaf, feature_sharding(mesh))
+    return jax.device_put(leaf, data_sharding(mesh, np.ndim(leaf)))
+
+
+def _stage_tree(mesh, tree, fill, spec: str):
+    """Pad (data-spec leaves, leading axis to a mesh multiple) + shard one
+    array or FeatureMatrix pytree.  Returns (staged, nbytes)."""
+    from photon_ml_tpu.ops import features as fops
+    if tree is None:
+        return None, 0
+    if isinstance(tree, (np.ndarray, jnp.ndarray, jax.Array)) \
+            or not hasattr(tree, "tree_flatten"):
+        a = tree if hasattr(tree, "shape") else np.asarray(tree)
+        if spec == "data":
+            rem = (-a.shape[0]) % mesh.shape[DATA_AXIS]
+            a = _pad_axis0(a, rem, fill)
+        staged = _put_leaf(mesh, a, spec)
+        return staged, int(staged.nbytes)
+    # FeatureMatrix pytree (PaddedSparse / KroneckerDesign): pad via the
+    # shared pad_rows, then shard every array leaf on its leading axis
+    rem = (-tree.shape[0]) % mesh.shape[DATA_AXIS]
+    padded = fops.pad_rows(tree, rem)
+    staged = jax.tree_util.tree_map(lambda l: _put_leaf(mesh, l, spec),
+                                    padded)
+    nbytes = sum(int(l.nbytes) for l in jax.tree_util.tree_leaves(staged))
+    return staged, nbytes
+
+
+def _mesh_fingerprint(mesh) -> tuple:
+    return (tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def _as_tuple(key) -> tuple:
+    return key if isinstance(key, tuple) else (key,)
+
+
+class MeshResidency:
+    """Keyed registry of padded + sharded STATIC coordinate arrays.
+
+    An entry is keyed ``(coordinate key, field, mesh fingerprint)`` and
+    pins the SOURCE array it was staged from: a call with a different
+    source object (the coordinate rebuilt / re-streamed its blocks)
+    re-stages in place — per-coordinate staleness, no global flush.
+    Bounded FIFO: an entry pins sharded device memory, so the registry
+    caps entries and ages out the oldest."""
+
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self.stats = TransferStats()
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._jitter = random.Random(0)
+
+    # -- staging --------------------------------------------------------------
+    def _transfer_with_retry(self, mesh, host_or_build, fill, spec,
+                             key, field, warm: bool):
+        """One staged transfer under the Prefetcher's transient/fatal
+        discipline; `host_or_build` is the array or a zero-arg callable
+        producing it (deferred so a retry re-reads the source)."""
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                faults.fire("mesh.stage", key=str(key), field=field)
+                src = (host_or_build() if callable(host_or_build)
+                       else host_or_build)
+                staged, nbytes = _stage_tree(mesh, src, fill, spec)
+                self.stats.note_stage(nbytes, warm=warm)
+                return staged, nbytes
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                if not faults.is_transient(e):
+                    raise MeshStagingError(
+                        f"mesh staging failed for {key!r}/{field} (fatal "
+                        f"{type(e).__name__}, not retryable)") from e
+                if attempt >= STAGE_MAX_ATTEMPTS:
+                    raise MeshStagingError(
+                        f"mesh staging failed for {key!r}/{field} after "
+                        f"{attempt} attempt(s)") from e
+                self.stats.note_retry()
+                delay = (STAGE_BACKOFF_S * (2 ** (attempt - 1))
+                         * (1.0 + STAGE_BACKOFF_JITTER
+                            * self._jitter.random()))
+                time.sleep(delay)
+
+    def stage_static(self, key, field: str, mesh, source, fill=0.0, *,
+                     build: Optional[Callable[[], object]] = None,
+                     spec: str = "data"):
+        """Memoized pad+shard of one static array (or FeatureMatrix /
+        normalization pytree).  `source` anchors identity — a later call
+        with the same source object returns the cached sharded copy with
+        ZERO transfer; a different source re-stages (and counts an
+        invalidation).  `build` optionally derives the actual staged host
+        array from the source (e.g. a reshape view), deferred so cache
+        hits never build it."""
+        if source is None:
+            return None
+        full_key = (_as_tuple(key), field, _mesh_fingerprint(mesh))
+        with self._lock:
+            entry = self._entries.get(full_key)
+            if entry is not None and entry[0] is source:
+                self._entries.move_to_end(full_key)
+                return entry[1]
+            replacing = entry is not None
+        staged, _ = self._transfer_with_retry(
+            mesh, build if build is not None else source, fill, spec,
+            key, field, warm=False)
+        with self._lock:
+            if replacing:
+                self.stats.note_invalidation()
+            self._entries[full_key] = (source, staged)
+            self._entries.move_to_end(full_key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.stats.note_eviction()
+        return staged
+
+    def stage_update(self, mesh, array, fill=0.0, *, spec: str = "data",
+                     key="update", field: str = "operand"):
+        """Per-visit operand staging (residual offsets, x0): never
+        memoized, counted WARM.  These are the only bytes a steady-state
+        mesh iteration should move."""
+        if array is None:
+            return None
+        staged, _ = self._transfer_with_retry(mesh, array, fill, spec,
+                                              key, field, warm=True)
+        return staged
+
+    # -- invalidation ---------------------------------------------------------
+    def invalidate(self, key) -> int:
+        """Drop every entry whose coordinate key starts with `key` (all
+        fields, all meshes).  The residency manager's per-coordinate
+        eviction hook — other coordinates' staged blocks are untouched."""
+        prefix = _as_tuple(key)
+        with self._lock:
+            doomed = [k for k in self._entries
+                      if k[0][: len(prefix)] == prefix]
+            for k in doomed:
+                del self._entries[k]
+        if doomed:
+            self.stats.note_invalidation(len(doomed))
+        return len(doomed)
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+        if n:
+            self.stats.note_invalidation(n)
+        return n
+
+    def num_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self) -> Tuple[tuple, ...]:
+        with self._lock:
+            return tuple(self._entries)
+
+
+# -- process-global default registry ------------------------------------------
+# One registry serves every estimator in the process (entries are keyed by
+# coordinate identity + mesh, so fits never collide); module-level so the
+# descent loop, benches, and the CLI summary all read one TransferStats.
+
+_DEFAULT: Optional[MeshResidency] = None
+
+
+def default_residency() -> MeshResidency:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = MeshResidency()
+    return _DEFAULT
+
+
+def transfer_snapshot() -> Dict[str, int]:
+    """Current global transfer counters (monotonic; consumers diff
+    snapshots via TransferStats.delta)."""
+    return default_residency().stats.snapshot()
+
+
+def invalidate(key) -> int:
+    return default_residency().invalidate(key)
+
+
+def clear() -> int:
+    return default_residency().clear()

@@ -21,7 +21,6 @@ is what makes [E, S, d] compact.
 """
 from __future__ import annotations
 
-import collections
 import dataclasses
 import functools
 from typing import Optional
@@ -33,7 +32,6 @@ from jax.sharding import Mesh
 from photon_ml_tpu.ops import GLMObjective
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim import OptimizerConfig, RegularizationContext, SolveResult, solve
-from photon_ml_tpu.parallel.mesh import data_sharding, replicated
 
 
 @jax.tree_util.register_pytree_node_class
@@ -112,18 +110,14 @@ def _cached_batched_solver(loss: PointwiseLoss, config: OptimizerConfig,
                    donate_argnums=(5,) if donate else ())
 
 
-# (blocks identity, mesh shape) -> padded + sharded static block arrays.
-# Bounded FIFO: an entry pins ~one bucket of device memory, and eviction /
-# rebuild changes the blocks' identity so stale entries age out the front.
-_MESH_BLOCK_CACHE: "collections.OrderedDict" = collections.OrderedDict()
-_MESH_BLOCK_CACHE_MAX = 32
-
-
 def clear_mesh_block_cache() -> None:
-    """Release every memoized padded/sharded block (the HBM residency
-    manager calls this when evicting an entity coordinate that trained
-    through a mesh — the cache would otherwise pin the evicted blocks)."""
-    _MESH_BLOCK_CACHE.clear()
+    """DEPRECATED global flush: drops EVERY coordinate's memoized sharded
+    arrays from the mesh residency layer.  Eviction now invalidates per
+    coordinate (`mesh_residency.invalidate(key)` — what the HBM residency
+    manager's hooks call); this alias remains for callers that still want
+    the sledgehammer."""
+    from photon_ml_tpu.parallel.mesh_residency import clear
+    clear()
 
 
 def fit_random_effects(
@@ -136,6 +130,7 @@ def fit_random_effects(
     reg_weight: jax.Array | float = 0.0,
     donate_buffers: bool = False,
     budget=None,
+    cache_key=None,
 ) -> SolveResult:
     """All per-entity solves as one batched program.
 
@@ -169,38 +164,30 @@ def fit_random_effects(
 
     # auto-pad the entity axis to a mesh multiple with all-masked lanes
     # (real datasets are rarely device-count multiples); results sliced back.
-    # The padded + device_put STATIC blocks (x/labels/mask/weights) are
-    # memoized per (blocks identity, mesh shape): coordinate descent calls
-    # this every update with the SAME blocks and only fresh offsets/x0, and
-    # rebuilding the entity-axis padding (a full concatenate + device_put
-    # per array) on every visit made steady-state mesh updates re-transfer
-    # the whole dataset.  Only the offsets and x0 move per call now.
+    # The padded + device_put STATIC blocks (x/labels/mask/weights) stage
+    # through the mesh residency layer — one sharded copy per coordinate
+    # key, identity-guarded, invalidated per coordinate (game/residency.py
+    # eviction hook) — so a warm visit moves only offsets and x0.  A
+    # factored coordinate's latent blocks change x every alternation
+    # (project_blocks with a refit P): only that field re-stages; its
+    # labels/mask/weights entries still hit.
     from photon_ml_tpu.parallel.mesh import DATA_AXIS
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    res_reg = default_residency()
     pad_e = (-E) % mesh.shape[DATA_AXIS]
-    put = lambda a: None if a is None else jax.device_put(
-        a, data_sharding(mesh, a.ndim))
-    zfill = lambda a, v: a if not pad_e else jnp.concatenate(
-        [a, jnp.full((pad_e,) + a.shape[1:], v, a.dtype)])
-    key = (id(blocks.x), blocks.x.shape, str(blocks.x.dtype),
-           blocks.weights is not None, mesh.shape[DATA_AXIS],
-           tuple(dev.id for dev in mesh.devices.flat))
-    entry = _MESH_BLOCK_CACHE.get(key)
-    if entry is None or entry[0] is not blocks.x:
-        entry = (blocks.x,                       # pins the id; staleness guard
-                 put(zfill(blocks.x, 0.0)),
-                 put(zfill(blocks.labels, 0.5)),
-                 put(zfill(blocks.mask, 0.0)),
-                 None if blocks.weights is None
-                 else put(zfill(blocks.weights, 0.0)))
-        _MESH_BLOCK_CACHE[key] = entry
-        while len(_MESH_BLOCK_CACHE) > _MESH_BLOCK_CACHE_MAX:
-            _MESH_BLOCK_CACHE.popitem(last=False)
-    _, x_dev, labels_dev, mask_dev, weights_dev = entry
-    offsets_dev = (None if blocks.offsets is None
-                   else put(zfill(blocks.offsets, 0.0)))
+    key = (cache_key if cache_key is not None
+           else ("fit_random_effects", id(blocks.x)))
+    x_dev = res_reg.stage_static(key, "x", mesh, blocks.x, 0.0)
+    labels_dev = res_reg.stage_static(key, "labels", mesh, blocks.labels, 0.5)
+    mask_dev = res_reg.stage_static(key, "mask", mesh, blocks.mask, 0.0)
+    weights_dev = res_reg.stage_static(key, "weights", mesh, blocks.weights,
+                                       0.0)
+    offsets_dev = res_reg.stage_update(mesh, blocks.offsets, 0.0, key=key,
+                                       field="offsets")
+    x0_dev = res_reg.stage_update(mesh, x0, 0.0, key=key, field="x0")
     with mesh:
         res = batched(x_dev, labels_dev, mask_dev, weights_dev, offsets_dev,
-                      put(zfill(x0, 0.0)), lam, budget)
+                      x0_dev, lam, budget)
     if pad_e:
         res = jax.tree_util.tree_map(lambda a: a[:E], res)
     return res

@@ -30,7 +30,14 @@ work.  This module makes faults FIRST-CLASS and REPRODUCIBLE:
 Injection sites currently threaded (ctx keys in parentheses):
 
   stage.fetch       chunk staging host read        (chunk)
-  stage.transfer    chunk host->device transfer    (chunk)
+  stage.transfer    chunk host->device transfer    (chunk; covers mesh-
+                    sharded chunk staging too — the transfer callable is
+                    behind the same site)
+  mesh.stage        mesh residency pad+shard       (key, field)
+                    transfer (parallel/mesh_residency.py + the
+                    pad_and_shard_rows scoring path); transient faults
+                    retry with the Prefetcher's backoff discipline,
+                    fatal ones raise MeshStagingError
   checkpoint.write  checkpoint record write start  (iteration)
   checkpoint.fsync  after state.json.tmp fsync,    (iteration)
                     before the atomic rename — a "kill" here is the

@@ -152,6 +152,52 @@ def test_faults_smoke(tmp_path, monkeypatch):
         poisoned["parity_gate"]
 
 
+def test_mesh_smoke(tmp_path):
+    """bench.py --mesh --smoke end-to-end in tier-1 (ISSUE 6 satellite):
+    the multi-chip harness — mesh-resident staging, per-device budgets,
+    mesh-streamed out-of-core, transfer + compile gates — cannot rot
+    without failing the normal test run.  This is ALSO the tier-1
+    multichip coverage that replaces the ad-hoc dryrun_multichip entry
+    (which now drives this same path).  Wall-clock is a smoke signal only:
+    virtual CPU devices share cores, so the honest gates are parity,
+    transfer behavior, and compile stability."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_mesh.json"
+    result = bench.mesh_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["devices"] >= 8
+    # f64 parity, hard-gated on EVERY leg (FE, RE, factored, streamed)
+    assert detail["all_parity_ok"] is True
+    assert result["value"] <= 1e-4
+    names = {e["name"] for e in detail["entries"]}
+    assert {"mesh_fe", "mesh_re", "mesh_factored", "mesh_streamed"} <= names
+    # warm iterations move only coefficients+offsets — never the dataset
+    # (the factored leg's latent blocks legitimately re-project per visit,
+    # so only its plain coordinates enter the warm gate)
+    assert detail["all_warm_transfer_ok"] is True
+    for e in detail["entries"]:
+        if e["name"] in ("mesh_fe", "mesh_re"):
+            assert e["warm_run_staged"]["cold_bytes"] == 0
+        if "warm_run_bit_identical_history" in e:
+            assert e["warm_run_bit_identical_history"] is True
+    re_leg = next(e for e in detail["entries"] if e["name"] == "mesh_re")
+    assert re_leg["warm_run_staged"]["warm_bytes"] > 0
+    # zero fresh traces across warm outer iterations
+    assert detail["all_zero_fresh_traces"] is True
+    # mesh x streaming: per-device data > per-device budget, peak under it
+    stream = next(e for e in detail["entries"] if e["name"] == "mesh_streamed")
+    assert stream["data_exceeds_budget"] is True
+    assert stream["streamed_engaged_ok"] is True
+    assert stream["under_budget_ok"] is True
+    assert stream["per_device_accounting"]["data_devices"] >= 8
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a
