@@ -1,0 +1,8 @@
+"""`python -m photon_ml_tpu.analysis` == `python -m
+photon_ml_tpu.analysis.lint`."""
+import sys
+
+from photon_ml_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

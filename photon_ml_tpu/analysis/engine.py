@@ -1,0 +1,653 @@
+"""photonlint engine: module semantics, suppressions, baseline, driver.
+
+The semantic layer is what separates this from a grep.  Per module it
+resolves import aliases to dotted origins (`jnp.linalg.norm` ->
+`jax.numpy.linalg.norm`), recognizes every jit/vmap wrapper form the repo
+uses (decorator, `functools.partial(jax.jit, ...)` decorator, call-form
+`jax.jit(f, donate_argnums=...)`, nested `jax.jit(jax.vmap(f, ...))`,
+attribute-bound programs), maps `static_argnums`/`donate_argnums` positions
+back to parameter NAMES, and tracks which local names hold device values
+(annotations, `jnp.*`/`jax.device_put` constructors, results of known
+jitted callables, arithmetic on device operands).  Rules consume this
+through `ModuleContext` — they never re-derive imports or wrappers.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: directory components whose modules are hot paths (PH001 applies)
+HOT_PATH_DIRS = ("ops", "optim", "game", "parallel", "serving")
+
+#: path suffixes of modules whose file writes must be durable (PH005);
+#: utils/durable.py is the helper implementation and is exempt
+DURABLE_MODULE_SUFFIXES = (
+    "models/io.py",
+    "game/coordinate_descent.py",
+    "data/index_map.py",
+)
+DURABLE_IMPL_SUFFIX = "utils/durable.py"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*photonlint:\s*(disable-file|disable|flush-point)"
+    r"(?:\s*=\s*(PH[0-9]{3}(?:\s*,\s*PH[0-9]{3})*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a precise span."""
+
+    rule: str
+    path: str          # display path (as passed / relative to cwd)
+    line: int
+    col: int
+    message: str
+    text: str          # stripped source line — the baseline identity
+
+    @property
+    def baseline_path(self) -> str:
+        """Path key stable across checkouts: the subpath from the
+        `photon_ml_tpu` package component when present."""
+        parts = self.path.replace(os.sep, "/").split("/")
+        if "photon_ml_tpu" in parts:
+            return "/".join(parts[parts.index("photon_ml_tpu"):])
+        return "/".join(parts)
+
+    @property
+    def baseline_key(self) -> Tuple[str, str, str]:
+        # line numbers are deliberately NOT part of the identity: a
+        # baselined finding survives unrelated edits above it
+        return (self.rule, self.baseline_path, self.text)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "text": self.text}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}")
+
+
+# -- suppression pragmas ------------------------------------------------------
+
+class Suppressions:
+    """Per-file pragma index: line pragmas, file pragmas, flush points."""
+
+    def __init__(self, lines: Sequence[str]):
+        self.file_all = False
+        self.file_rules: Set[str] = set()
+        self.line_all: Set[int] = set()
+        self.line_rules: Dict[int, Set[str]] = {}
+        self.flush_lines: Set[int] = set()
+        for lineno, text in enumerate(lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            kind, ids_text = m.group(1), m.group(2)
+            ids = ({i.strip() for i in ids_text.split(",")}
+                   if ids_text else None)
+            if kind == "flush-point":
+                self.flush_lines.add(lineno)
+            elif kind == "disable-file":
+                if ids is None:
+                    self.file_all = True
+                else:
+                    self.file_rules |= ids
+            else:  # disable
+                if ids is None:
+                    self.line_all.add(lineno)
+                else:
+                    self.line_rules.setdefault(lineno, set()).update(ids)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return (self.file_all or rule in self.file_rules
+                or line in self.line_all
+                or rule in self.line_rules.get(line, ()))
+
+
+# -- wrapper semantics --------------------------------------------------------
+
+_JIT_ORIGINS = {"jax.jit", "jax.pjit"}
+_TRACE_ORIGINS = _JIT_ORIGINS | {"jax.vmap", "jax.pmap"}
+_PARTIAL_ORIGINS = {"functools.partial"}
+
+
+@dataclasses.dataclass
+class WrapInfo:
+    """Decoded jit/vmap wrapper: which params are static, which are
+    donated (by name AND by position, so both decorator-form bodies and
+    call sites can be checked)."""
+
+    kinds: Set[str] = dataclasses.field(default_factory=set)
+    static_names: Set[str] = dataclasses.field(default_factory=set)
+    static_positions: Set[int] = dataclasses.field(default_factory=set)
+    donate_names: Set[str] = dataclasses.field(default_factory=set)
+    donate_positions: Set[int] = dataclasses.field(default_factory=set)
+
+    @property
+    def traced(self) -> bool:
+        return bool(self.kinds)
+
+
+def _const_int_tuple(node) -> Tuple[int, ...]:
+    """Extract (1, 2) / 1 / [1, 2] of literal ints; IfExp takes the truthy
+    arm (`donate_argnums=(5,) if donate else ()` — decode the donating
+    configuration, the hazard we want visible)."""
+    if isinstance(node, ast.IfExp):
+        node = node.body
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _const_str_tuple(node) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+class ModuleContext:
+    """Parsed module + resolved semantics handed to every rule."""
+
+    def __init__(self, path: str, display_path: str, source: str):
+        self.path = path
+        self.display_path = display_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = Suppressions(self.lines)
+        # import alias tables
+        self.modules: Dict[str, str] = {}   # local name -> dotted module
+        self.names: Dict[str, str] = {}     # local name -> dotted origin
+        self._scan_imports()
+        # wrapper semantics
+        self.traced_defs: Dict[ast.AST, WrapInfo] = {}
+        self.jitted_callables: Dict[str, WrapInfo] = {}
+        self.jitted_attrs: Dict[str, WrapInfo] = {}
+        self._defs_by_name: Dict[str, ast.AST] = {}
+        self._scan_wrappers()
+
+    # -- path classification --------------------------------------------------
+    @property
+    def norm_path(self) -> str:
+        return self.display_path.replace(os.sep, "/")
+
+    @property
+    def is_hot_path(self) -> bool:
+        return any(p in HOT_PATH_DIRS for p in self.norm_path.split("/")[:-1])
+
+    @property
+    def is_durable_module(self) -> bool:
+        return self.norm_path.endswith(DURABLE_MODULE_SUFFIXES)
+
+    @property
+    def is_durable_impl(self) -> bool:
+        return self.norm_path.endswith(DURABLE_IMPL_SUFFIX)
+
+    # -- imports --------------------------------------------------------------
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.modules[alias.asname or
+                                 alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else
+                        alias.name.split(".")[0])
+                    if alias.asname:
+                        self.modules[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = node.module  # relative imports keep the tail only
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.names[local] = f"{base}.{alias.name}"
+
+    def resolve(self, node) -> Optional[str]:
+        """Dotted origin of a Name/Attribute chain, or None."""
+        if isinstance(node, ast.Name):
+            if node.id in self.names:
+                return self.names[node.id]
+            if node.id in self.modules:
+                return self.modules[node.id]
+            return None
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    def resolves_to(self, node, origins) -> bool:
+        r = self.resolve(node)
+        return r is not None and r in origins
+
+    # -- wrapper decoding -----------------------------------------------------
+    def _decode_wrapper(self, node, info: Optional[WrapInfo] = None
+                        ) -> Tuple[Optional[WrapInfo], Optional[ast.AST]]:
+        """Decode a decorator/call wrapper expression.  Returns
+        (WrapInfo, wrapped-function-expr-or-None); None info when the
+        expression is not a recognized wrapper."""
+        info = info or WrapInfo()
+        origin = self.resolve(node)
+        if origin in _TRACE_ORIGINS:  # bare @jax.jit / @vmap
+            info.kinds.add(origin.rsplit(".", 1)[-1])
+            return info, None
+        if not isinstance(node, ast.Call):
+            return None, None
+        func_origin = self.resolve(node.func)
+        if func_origin in _PARTIAL_ORIGINS:
+            # @functools.partial(jax.jit, static_argnames=..., ...)
+            if not node.args:
+                return None, None
+            inner, _ = self._decode_wrapper(node.args[0], info)
+            if inner is None:
+                return None, None
+            self._apply_wrapper_kwargs(node, inner)
+            return inner, None
+        if func_origin in _TRACE_ORIGINS:
+            # call form: jax.jit(f, ...) / jax.jit(jax.vmap(f, ...), ...)
+            info.kinds.add(func_origin.rsplit(".", 1)[-1])
+            self._apply_wrapper_kwargs(node, info)
+            wrapped = node.args[0] if node.args else None
+            if isinstance(wrapped, ast.Call):
+                nested, deeper = self._decode_wrapper(wrapped, info)
+                if nested is not None:
+                    return info, deeper
+            return info, wrapped
+        return None, None
+
+    def _apply_wrapper_kwargs(self, call: ast.Call, info: WrapInfo) -> None:
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                info.static_positions |= set(_const_int_tuple(kw.value))
+            elif kw.arg == "static_argnames":
+                info.static_names |= set(_const_str_tuple(kw.value))
+            elif kw.arg == "donate_argnums":
+                info.donate_positions |= set(_const_int_tuple(kw.value))
+            elif kw.arg == "donate_argnames":
+                info.donate_names |= set(_const_str_tuple(kw.value))
+
+    @staticmethod
+    def _positions_to_names(info: WrapInfo, args: ast.arguments) -> None:
+        """Complete the position<->name mapping both ways, so decorator
+        bodies (names) and call sites (positions or keywords) can both be
+        checked regardless of which spelling the wrapper used."""
+        params = [a.arg for a in args.posonlyargs + args.args]
+        index = {p: i for i, p in enumerate(params)}
+        for i in list(info.static_positions):
+            if i < len(params):
+                info.static_names.add(params[i])
+        for i in list(info.donate_positions):
+            if i < len(params):
+                info.donate_names.add(params[i])
+        for n in info.static_names:
+            if n in index:
+                info.static_positions.add(index[n])
+        for n in info.donate_names:
+            if n in index:
+                info.donate_positions.add(index[n])
+
+    def _scan_wrappers(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(node.name, node)
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    info, _ = self._decode_wrapper(dec)
+                    if info is not None and info.traced:
+                        self._positions_to_names(info, node.args)
+                        self.traced_defs[node] = info
+                        self.jitted_callables[node.name] = info
+                        break
+            elif isinstance(node, (ast.Assign, ast.Return)):
+                value = (node.value if isinstance(node, (ast.Assign,
+                                                         ast.Return))
+                         else None)
+                if value is None or not isinstance(value, ast.Call):
+                    continue
+                info, wrapped = self._decode_wrapper(value)
+                if info is None or not info.traced:
+                    continue
+                target_def = None
+                if isinstance(wrapped, ast.Name):
+                    target_def = self._defs_by_name.get(wrapped.id)
+                elif isinstance(wrapped, ast.Lambda):
+                    target_def = wrapped
+                if target_def is not None:
+                    self._positions_to_names(info, target_def.args)
+                    self.traced_defs[target_def] = info
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.jitted_callables[tgt.id] = info
+                        elif isinstance(tgt, ast.Attribute):
+                            self.jitted_attrs[tgt.attr] = info
+
+    def callable_info(self, func_node) -> Optional[WrapInfo]:
+        """WrapInfo for a call-site func expression (`solver(...)`,
+        `self._program(...)`) when it names a known jitted callable."""
+        if isinstance(func_node, ast.Name):
+            return self.jitted_callables.get(func_node.id)
+        if isinstance(func_node, ast.Attribute):
+            return self.jitted_attrs.get(func_node.attr)
+        return None
+
+    def flush_point(self, fn_node) -> bool:
+        """True when the `def` line (or the line above it) carries the
+        `# photonlint: flush-point` marker."""
+        line = getattr(fn_node, "lineno", 0)
+        return (line in self.suppressions.flush_lines
+                or line - 1 in self.suppressions.flush_lines)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.display_path, line=line,
+                       col=col + 1, message=message,
+                       text=self.line_text(line))
+
+
+# -- device-value expression semantics (shared by PH001/PH003) ---------------
+
+_DEVICE_ROOTS = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.scipy.")
+_DEVICE_CALLS = {"jax.device_put", "jax.block_until_ready"}
+_DEVICE_ANNOTATIONS = {"jax.Array", "jax.numpy.ndarray", "jnp.ndarray",
+                       "Array"}
+_HOST_CALLS = {"jax.device_get", "numpy.asarray", "numpy.array", "float",
+               "int", "bool"}
+
+
+def is_device_annotation(ctx: ModuleContext, node) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _DEVICE_ANNOTATIONS or node.value.endswith(
+            (".Array", ".ndarray"))
+    origin = ctx.resolve(node)
+    return origin in _DEVICE_ANNOTATIONS if origin else False
+
+
+class DeviceTracker:
+    """Ordered walk of one function (or module) body tracking which local
+    names hold device values.  Rules register callbacks for the events
+    they care about; the tracker guarantees source order so "assigned
+    then used" reasoning is sound."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.device: Set[str] = set()
+
+    # -- expression classification -------------------------------------------
+    def is_device_expr(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.device
+        if isinstance(node, ast.Call):
+            origin = self.ctx.resolve(node.func)
+            if origin:
+                if origin in _HOST_CALLS:
+                    return False
+                if origin in _DEVICE_CALLS or origin.startswith(
+                        _DEVICE_ROOTS):
+                    return True
+            return self.ctx.callable_info(node.func) is not None
+        if isinstance(node, ast.BinOp):
+            return (self.is_device_expr(node.left)
+                    or self.is_device_expr(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.is_device_expr(node.operand)
+        if isinstance(node, ast.Subscript):
+            return self.is_device_expr(node.value)
+        if isinstance(node, ast.Attribute):
+            # conservatively: an attribute of a device pytree is device,
+            # but known-static metadata attributes are host
+            if node.attr in ("shape", "ndim", "dtype", "size", "nbytes"):
+                return False
+            return self.is_device_expr(node.value)
+        if isinstance(node, ast.IfExp):
+            return (self.is_device_expr(node.body)
+                    or self.is_device_expr(node.orelse))
+        return False
+
+    # -- statement walk --------------------------------------------------------
+    def _bind(self, target, device: bool) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                (self.device.add if device
+                 else self.device.discard)(n.id)
+
+    def seed_params(self, fn_node) -> None:
+        args = fn_node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if is_device_annotation(self.ctx, a.annotation):
+                self.device.add(a.arg)
+
+    def walk(self, body, on_expr) -> None:
+        """Visit statements in order.  `on_expr(expr_statement_value)` is
+        invoked for every expression tree right BEFORE its bindings take
+        effect, with the tracker state as of that point."""
+        for stmt in body:
+            self._walk_stmt(stmt, on_expr)
+
+    def _walk_stmt(self, stmt, on_expr) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are linted separately
+        if isinstance(stmt, ast.Assign):
+            on_expr(stmt.value)
+            dev = self.is_device_expr(stmt.value)
+            for tgt in stmt.targets:
+                self._bind(tgt, dev)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                on_expr(stmt.value)
+            dev = (is_device_annotation(self.ctx, stmt.annotation)
+                   or (stmt.value is not None
+                       and self.is_device_expr(stmt.value)))
+            self._bind(stmt.target, dev)
+        elif isinstance(stmt, ast.AugAssign):
+            on_expr(stmt.value)
+        elif isinstance(stmt, ast.For):
+            on_expr(stmt.iter)
+            self._bind(stmt.target, self.is_device_expr(stmt.iter))
+            self.walk(stmt.body, on_expr)
+            self.walk(stmt.orelse, on_expr)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            on_expr(stmt.test)
+            self.walk(stmt.body, on_expr)
+            self.walk(stmt.orelse, on_expr)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                on_expr(item.context_expr)
+            self.walk(stmt.body, on_expr)
+        elif isinstance(stmt, ast.Try):
+            self.walk(stmt.body, on_expr)
+            for h in stmt.handlers:
+                self.walk(h.body, on_expr)
+            self.walk(stmt.orelse, on_expr)
+            self.walk(stmt.finalbody, on_expr)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                on_expr(stmt.value)
+        elif isinstance(stmt, (ast.Raise,)):
+            if stmt.exc is not None:
+                on_expr(stmt.exc)
+        # pass/break/continue/import/global/nonlocal/assert/del: no device
+        # bindings worth tracking (assert bodies are cold by definition)
+
+
+def iter_function_defs(tree) -> Iterable[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def comprehension_device_names(tracker: DeviceTracker, node) -> Set[str]:
+    """Loop targets of comprehensions iterating a device value — a
+    per-element host sync factory (`float(e) for e in jnp.asarray(v)`)."""
+    out: Set[str] = set()
+    for comp in getattr(node, "generators", ()):
+        if tracker.is_device_expr(comp.iter):
+            for n in ast.walk(comp.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+# -- fault-site registry (static) --------------------------------------------
+
+def load_sites_registry(paths: Sequence[str]) -> Tuple[Dict[str,
+                                                            Tuple[str, ...]],
+                                                       Optional[str]]:
+    """Statically parse `SITES = {...}` from a linted `faults.py`, falling
+    back to the shipped `photon_ml_tpu/utils/faults.py`.  Returns
+    (site -> declared ctx keys, source path) — empty dict when no registry
+    exists anywhere (PH004 then reports every literal as undeclared)."""
+    candidates = [p for p in paths if p.endswith("faults.py")]
+    shipped = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "utils", "faults.py")
+    if os.path.exists(shipped):
+        candidates.append(shipped)
+    for cand in candidates:
+        try:
+            with open(cand, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in tree.body:
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target] if isinstance(node, ast.AnnAssign)
+                       else [])
+            if not any(isinstance(t, ast.Name) and t.id == "SITES"
+                       for t in targets):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Dict):
+                continue
+            registry: Dict[str, Tuple[str, ...]] = {}
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    registry[k.value] = _const_str_tuple(v)
+            return registry, cand
+    return {}, None
+
+
+# -- baseline -----------------------------------------------------------------
+
+class Baseline:
+    """Committed grandfather list.  Identity = (rule, package-relative
+    path, stripped source line) so entries survive line drift; matching is
+    multiset-aware (two identical lines need two entries)."""
+
+    def __init__(self, entries: Sequence[dict]):
+        self._counts: Dict[Tuple[str, str, str], int] = {}
+        for e in entries:
+            key = (e["rule"], e["path"], e["text"])
+            self._counts[key] = self._counts.get(key, 0) + 1
+        self.total = len(entries)
+
+    @staticmethod
+    def load(path: str) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except OSError:
+            return Baseline([])
+        return Baseline(data.get("findings", []))
+
+    @staticmethod
+    def write(path: str, findings: Sequence[Finding]) -> int:
+        entries = sorted(
+            ({"rule": f.rule, "path": f.baseline_path, "text": f.text}
+             for f in findings),
+            key=lambda e: (e["path"], e["rule"], e["text"]))
+        payload = {
+            "version": 1,
+            "comment": ("photonlint grandfathered findings — regenerate "
+                        "with --write-baseline; shrink, never grow"),
+            "findings": entries,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        return len(entries)
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], int]:
+        """-> (new, baselined, stale_entry_count)."""
+        remaining = dict(self._counts)
+        new, old = [], []
+        for f in findings:
+            if remaining.get(f.baseline_key, 0) > 0:
+                remaining[f.baseline_key] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        stale = sum(remaining.values())
+        return new, old, stale
+
+
+# -- driver -------------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, n) for n in sorted(names)
+                           if n.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run every (selected) rule over every .py file under `paths`.
+    Suppressions are applied; the baseline is NOT (lint.py owns that)."""
+    from photon_ml_tpu.analysis.rules import all_rules
+    files = iter_py_files(paths)
+    registry, registry_path = load_sites_registry(files)
+    rules = [r for r in all_rules()
+             if select is None or r.rule_id in select]
+    findings: List[Finding] = []
+    for path in files:
+        display = os.path.relpath(path) if os.path.isabs(path) else path
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctx = ModuleContext(path, display, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(
+                rule="PH000", path=display,
+                line=getattr(e, "lineno", 1) or 1, col=1,
+                message=f"unparseable module: {e}", text=""))
+            continue
+        ctx.sites_registry = registry
+        ctx.sites_registry_path = registry_path
+        for rule in rules:
+            for f in rule.check(ctx):
+                if not ctx.suppressions.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

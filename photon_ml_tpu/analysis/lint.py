@@ -1,0 +1,117 @@
+"""photonlint CLI.
+
+    python -m photon_ml_tpu.analysis.lint photon_ml_tpu/
+    python -m photon_ml_tpu.analysis.lint --json path/ > findings.json
+    python -m photon_ml_tpu.analysis.lint --write-baseline photon_ml_tpu/
+
+Exit status: 0 = no findings beyond the committed baseline, 1 = new
+findings (CI-gateable), 2 = usage error.  `--json` emits a machine-
+readable report (findings + counts + baseline accounting) for CI
+annotation tooling.  The default baseline is the committed
+`photon_ml_tpu/analysis/baseline.json`; `--no-baseline` reports
+everything (how `--write-baseline` decides what to grandfather).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from photon_ml_tpu.analysis.engine import Baseline, Finding, lint_paths
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.analysis.lint",
+        description="photonlint: static enforcement of the hot-path "
+                    "invariants (sync points, retrace hazards, donation "
+                    "safety, fault sites, durable writes)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint (default: the "
+                        "photon_ml_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report instead of human output")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file of grandfathered findings "
+                        f"(default: {os.path.relpath(DEFAULT_BASELINE)})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to --baseline and "
+                        "exit 0 (grandfathering workflow)")
+    p.add_argument("--select", default=None, metavar="PH001,PH002",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _list_rules() -> None:
+    from photon_ml_tpu.analysis.rules import all_rules
+    for rule in all_rules():
+        print(f"{rule.rule_id}  {rule.name:16s} {rule.summary}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        _list_rules()
+        return 0
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    findings = lint_paths(paths, select=select)
+
+    if args.write_baseline:
+        n = Baseline.write(args.baseline, findings)
+        print(f"photonlint: wrote {n} baseline finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, old, stale = list(findings), [], 0
+        baseline_total = 0
+    else:
+        baseline = Baseline.load(args.baseline)
+        new, old, stale = baseline.split(findings)
+        baseline_total = baseline.total
+
+    if args.as_json:
+        report = {
+            "version": 1,
+            "findings": [dict(f.to_dict(), baselined=False) for f in new]
+            + [dict(f.to_dict(), baselined=True) for f in old],
+            "counts": {"new": len(new), "baselined": len(old),
+                       "stale_baseline_entries": stale},
+            "baseline": {"path": (None if args.no_baseline
+                                  else args.baseline),
+                         "total": baseline_total},
+        }
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print(f.render())
+        if old:
+            print(f"photonlint: {len(old)} baselined finding(s) "
+                  "suppressed (see --no-baseline)")
+        if stale:
+            print(f"photonlint: {stale} stale baseline entr"
+                  f"{'y' if stale == 1 else 'ies'} no longer match — "
+                  "regenerate with --write-baseline to shrink the "
+                  "baseline")
+        if new:
+            print(f"photonlint: {len(new)} new finding(s)")
+        else:
+            print("photonlint: clean")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,10 +108,10 @@ class IndexMapCollection:
     shards: Dict[str, IndexMap]
 
     def save(self, directory: str) -> None:
+        from photon_ml_tpu.utils.durable import atomic_write_json
         os.makedirs(directory, exist_ok=True)
         meta = {"shards": sorted(self.shards)}
-        with open(os.path.join(directory, "index-maps.json"), "w") as f:
-            json.dump(meta, f, indent=2)
+        atomic_write_json(os.path.join(directory, "index-maps.json"), meta)
         for shard, imap in self.shards.items():
             imap.save(os.path.join(directory, f"{shard}.index.npz"))
 

@@ -35,6 +35,7 @@ from photon_ml_tpu.game.coordinates import Coordinate
 from photon_ml_tpu.models.game import GameModel
 from photon_ml_tpu.ops import TASK_LOSSES
 from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import durable
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -111,7 +112,7 @@ def _data_term(total_scores, base_offsets, labels, weights, *, loss):
     return jnp.sum(l if weights is None else weights * l)
 
 
-def _sync(*arrays) -> float:
+def _sync(*arrays) -> float:  # photonlint: flush-point
     """True device sync via a scalar readback, returning the seconds the
     host was blocked (callers feed PhaseTimings.add_blocked).  Over the
     axon tunnel block_until_ready returns BEFORE execution completes; only
@@ -284,52 +285,14 @@ class CheckpointState:
 # whose manifest verifies -> fresh start; stale *.tmp files and orphaned
 # partial directories (no/failing manifest, unreferenced) are pruned.
 
-def _fsync_file(path: str) -> None:
-    try:
-        fd = os.open(path, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-    except OSError:  # best-effort (exotic filesystems)
-        pass
-
-
-def _fsync_dir(path: str) -> None:
-    _fsync_file(path)
-
-
-def _file_sha256(path: str) -> str:
-    import hashlib
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for block in iter(lambda: f.read(1 << 20), b""):
-            h.update(block)
-    return h.hexdigest()
-
-
-def _write_manifest(dirpath: str) -> None:
-    """Scan `dirpath` and write manifest.json LAST (tmp+rename+fsync): the
-    completeness marker a resume verifies.  Every data file is fsynced
-    first so a verifying manifest implies durable contents."""
-    files = {}
-    for root, _, names in os.walk(dirpath):
-        for fn in sorted(names):
-            if fn in ("manifest.json", "manifest.json.tmp"):
-                continue
-            p = os.path.join(root, fn)
-            rel = os.path.relpath(p, dirpath)
-            _fsync_file(p)
-            files[rel] = {"bytes": os.path.getsize(p),
-                          "sha256": _file_sha256(p)}
-    import json
-    tmp = os.path.join(dirpath, "manifest.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump({"format_version": 1, "files": files}, f, indent=1)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(dirpath, "manifest.json"))
-    _fsync_dir(dirpath)
+# the atomic write+fsync discipline lives in utils/durable.py (shared
+# with models/io.py; photonlint PH005 enforces that durable modules only
+# write through it) — the local underscore names are kept because the
+# crash tests and this module's call sites predate the extraction
+_fsync_file = durable.fsync_file
+_fsync_dir = durable.fsync_dir
+_file_sha256 = durable.file_sha256
+_write_manifest = durable.write_manifest
 
 
 def verify_checkpoint_dir(dirpath: str) -> Tuple[Optional[bool], str]:
@@ -417,8 +380,8 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
                   model_dir=os.path.basename(path),
                   best_model_dir=(os.path.basename(best_path)
                                   if best_path else None))
-    with open(os.path.join(path, "record.json"), "w") as f:
-        json.dump(record, f, indent=1)
+    durable.atomic_write_json(os.path.join(path, "record.json"), record,
+                              indent=1, fsync=False)  # manifest fsyncs it
     _write_manifest(path)  # seals the iter dir (covers record.json)
 
     # retention of TWO records: remember the predecessor so resume can fall
@@ -427,17 +390,13 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
         {k: prev.get(k) for k in ("completed_iterations", "model_dir",
                                   "best_model_dir")}
         if prev is not None else None)
-    tmp = os.path.join(directory, "state.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(state, f, indent=1)
-        f.flush()
-        os.fsync(f.fileno())
-    # a "kill" injected here is the canonical torn checkpoint: the new
-    # record is complete + sealed, state.json still points at the old one,
-    # and state.json.tmp is left for resume to prune
-    faults.fire("checkpoint.fsync", iteration=iteration)
-    os.replace(tmp, os.path.join(directory, "state.json"))
-    _fsync_dir(directory)
+    # a "kill" injected at the before_replace hook is the canonical torn
+    # checkpoint: the new record is complete + sealed, state.json still
+    # points at the old one, and state.json.tmp is left for resume to prune
+    durable.atomic_write_json(
+        os.path.join(directory, "state.json"), state, indent=1,
+        before_replace=lambda: faults.fire("checkpoint.fsync",
+                                           iteration=iteration))
     # prune the dirs the GRANDPARENT record referenced (two newest records
     # are retained); a foreign/corrupt state.json may point anywhere, so
     # only delete paths contained in the checkpoint directory
@@ -1013,7 +972,7 @@ def run_coordinate_descent(
         if residency is not None:
             residency.after_update(name)
 
-    def _quarantine_rerun(it: int, name: str) -> bool:
+    def _quarantine_rerun(it: int, name: str) -> bool:  # photonlint: flush-point
         """The ONE tightened-budget retry after a rollback, run at the
         point the divergence is discovered (the outer-iteration boundary
         in pipelined mode).  Its small health readback is fine — this is
@@ -1057,7 +1016,7 @@ def run_coordinate_descent(
             return "retry_ok" if _quarantine_rerun(it, name) else "frozen"
         return "frozen"
 
-    def flush_pending() -> None:
+    def flush_pending() -> None:  # photonlint: flush-point
         """ONE batched device_get for every objective + metric + HEALTH
         scalar of the outer iteration, then the deferred host bookkeeping
         (history appends, tracker summaries, best-model tracking, logging,
@@ -1204,7 +1163,10 @@ def run_coordinate_descent(
                         spans.add_blocked(obj_key, time.perf_counter() - t0)
                 if not pipelined:
                     healthy = (health_dev is True
-                               or bool(jax.device_get(health_dev)))
+                               # strict timing mode syncs per update BY
+                               # DESIGN — it exists to measure what
+                               # pipelining saves
+                               or bool(jax.device_get(health_dev)))  # photonlint: disable=PH001
                     if not healthy:
                         if not math.isfinite(obj):
                             _host_rollback(name, prev_model)

@@ -316,7 +316,8 @@ class FixedEffectCoordinate:
             # update (vs n*d of streamed feature traffic per oracle pass),
             # then the whole solve is host-stepped over chunk streams
             from photon_ml_tpu.optim.streaming import solve_streamed
-            off_host = np.asarray(offsets, dtype=self._canonical)
+            off_host = np.asarray(  # photonlint: disable=PH001 -- the documented ONE [n] readback per streamed update
+                offsets, dtype=self._canonical)
             obj = self._stream.replace(offsets=off_host)
             x0 = model.glm.coefficients.means
             if self.norm is not None:

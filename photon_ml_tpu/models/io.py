@@ -35,6 +35,8 @@ from photon_ml_tpu.models.game import (
     MatrixFactorizationModel, RandomEffectModel,
 )
 from photon_ml_tpu.models.glm import model_for_task
+from photon_ml_tpu.utils.durable import (atomic_write_json,
+                                         atomic_write_text, write_marker)
 
 _FORMAT_VERSION = 1
 
@@ -146,8 +148,7 @@ def save_game_model(
                 "task_type": m.task_type}
         else:
             raise TypeError(f"unknown coordinate model type {type(m)}")
-    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    atomic_write_json(os.path.join(directory, "model-metadata.json"), meta)
 
 
 def _save_game_model_avro(model, directory, config, index_maps) -> None:
@@ -238,8 +239,7 @@ def _save_game_model_avro(model, directory, config, index_maps) -> None:
     if used_maps:
         IndexMapCollection(used_maps).save(
             os.path.join(directory, "index-maps"))
-    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    atomic_write_json(os.path.join(directory, "model-metadata.json"), meta)
 
 
 def load_model_index_maps(directory: str) -> Optional[Dict[str, IndexMap]]:
@@ -491,11 +491,11 @@ def save_game_model_reference_layout(
         # Scala reference ignores unknown directories.
         IndexMapCollection(dict(index_maps)).save(
             os.path.join(directory, "index-maps"))
-    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
-        json.dump({"modelType": {v: k for k, v in _REFERENCE_TASKS.items()
-                                 if v}.get(model.task_type, "NONE"),
-                   "modelName": os.path.basename(directory.rstrip("/"))},
-                  f, indent=2)
+    atomic_write_json(
+        os.path.join(directory, "model-metadata.json"),
+        {"modelType": {v: k for k, v in _REFERENCE_TASKS.items()
+                       if v}.get(model.task_type, "NONE"),
+         "modelName": os.path.basename(directory.rstrip("/"))})
     for name, m in model.coordinates.items():
         if isinstance(m, MatrixFactorizationModel):
             raise ValueError(
@@ -508,8 +508,8 @@ def save_game_model_reference_layout(
             sub = os.path.join(directory, "fixed-effect", name)
             coeff_dir = os.path.join(sub, "coefficients")
             os.makedirs(coeff_dir, exist_ok=True)
-            with open(os.path.join(sub, "id-info"), "w") as f:
-                f.write(m.feature_shard + "\n")
+            atomic_write_text(os.path.join(sub, "id-info"),
+                              m.feature_shard + "\n")
             means = np.asarray(m.glm.coefficients.means)
             imap = (index_maps or {}).get(m.feature_shard) or \
                 _shard_index_map(None, m.feature_shard, len(means))
@@ -531,8 +531,9 @@ def save_game_model_reference_layout(
             sub = os.path.join(directory, "random-effect", name)
             coeff_dir = os.path.join(sub, "coefficients")
             os.makedirs(coeff_dir, exist_ok=True)
-            with open(os.path.join(sub, "id-info"), "w") as f:
-                f.write(m.random_effect_type + "\n" + m.feature_shard + "\n")
+            atomic_write_text(os.path.join(sub, "id-info"),
+                              m.random_effect_type + "\n"
+                              + m.feature_shard + "\n")
             imap = (index_maps or {}).get(m.feature_shard) or \
                 _shard_index_map(None, m.feature_shard, m.global_dim)
             E = m.num_entities
@@ -549,7 +550,7 @@ def save_game_model_reference_layout(
                     variances=(None if m.variances is None
                                else np.asarray(m.variances)[lo:hi]))
             # Spark leaves a _SUCCESS marker; the loader must skip it
-            open(os.path.join(coeff_dir, "_SUCCESS"), "w").close()
+            write_marker(os.path.join(coeff_dir, "_SUCCESS"))
         else:
             raise TypeError(f"unknown coordinate model type {type(m)}")
 
@@ -785,10 +786,10 @@ def save_glm(model, directory: str, index_map: Optional[IndexMap] = None,
     if index_map is not None:
         arrays["feature_keys"] = index_map.index_to_key.astype(object)
     np.savez_compressed(os.path.join(directory, "coefficients.npz"), **arrays)
-    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
-        json.dump({"format_version": _FORMAT_VERSION,
-                   "task_type": type(model).task_type,
-                   **(extra_metadata or {})}, f, indent=2)
+    atomic_write_json(os.path.join(directory, "model-metadata.json"),
+                      {"format_version": _FORMAT_VERSION,
+                       "task_type": type(model).task_type,
+                       **(extra_metadata or {})})
 
 
 def load_glm(directory: str):

@@ -236,7 +236,8 @@ class ChunkedGLMObjective:
         coordinate descent — only the feature block is out of core)."""
         out = None
         for spec, ch in self._prefetcher.stream():
-            z = np.asarray(_chunk_scores(ch["x"], c))
+            z = np.asarray(  # photonlint: disable=PH001 -- out-of-core scoring lands each chunk's [rows] margins on host by design
+                _chunk_scores(ch["x"], c))
             if out is None:
                 out = np.empty(self.plan.num_rows, z.dtype)
             out[spec.start:spec.stop] = z[:spec.rows]

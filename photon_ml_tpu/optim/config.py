@@ -17,6 +17,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optim.lbfgs import lbfgs
@@ -105,7 +106,10 @@ class OptimizerConfig:
         for name in ("box_lower", "box_upper"):
             v = getattr(self, name)
             if v is not None and not isinstance(v, tuple):
-                object.__setattr__(self, name, tuple(float(e) for e in jnp.asarray(v)))
+                # np, not jnp: jnp.asarray would stage the bounds to the
+                # device only to sync one element per float() (PH001)
+                object.__setattr__(self, name,
+                                   tuple(float(e) for e in np.asarray(v)))
         if self.constraints is not None:
             from photon_ml_tpu.optim.constraints import normalize_constraints
             if self.box_lower is not None or self.box_upper is not None:

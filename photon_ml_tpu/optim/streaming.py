@@ -26,6 +26,10 @@ counts) holds through the whole solve.
 """
 from __future__ import annotations
 
+# photonlint: disable-file=PH001 -- host-stepped BY DESIGN: this module IS
+# the documented exception to the batched-flush rule; the host reads back
+# exactly the scalars it branches on (see module docstring)
+
 import functools
 from typing import Callable, Optional, Tuple
 

@@ -1,0 +1,107 @@
+"""Durable small-file writes: the atomic write+fsync helpers every
+checkpoint/model-io module must use (photonlint rule PH005).
+
+A bare `open(path, "w")` torn by a crash leaves a half-written file that a
+resume then trusts; every metadata/state file in this repo instead goes
+tmp -> flush -> fsync -> atomic `os.replace` -> directory fsync, so at any
+instant the path either holds the complete old content or the complete new
+content.  `write_manifest` layers the checkpoint completeness marker on
+top: every data file fsynced, then a per-file size+sha256 manifest.json
+written LAST with the same atomic discipline (game/coordinate_descent.py
+resume verifies it).
+
+This module is the designated implementation and is exempt from PH005;
+everything under models/io.py, game/coordinate_descent.py and
+data/index_map.py must route writes through here.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Callable, Optional
+
+
+def fsync_file(path: str) -> None:
+    """Best-effort fsync of an existing file (exotic filesystems may
+    refuse; durability is then whatever the mount gives us)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def fsync_dir(path: str) -> None:
+    """Directory fsync: makes a rename/creation itself durable."""
+    fsync_file(path)
+
+
+def file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True,
+                      before_replace: Optional[Callable[[], None]] = None
+                      ) -> None:
+    """Write `text` to `path` via tmp+fsync+atomic-replace.  A crash at
+    any point leaves either the old complete file or the new complete
+    file, plus at worst a prunable `{path}.tmp`.  `before_replace` runs
+    between the fsync and the rename — the hook checkpointing uses to
+    place its `checkpoint.fsync` fault-injection site at the canonical
+    torn-write instant."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    if before_replace is not None:
+        before_replace()
+    os.replace(tmp, path)
+    if fsync:
+        fsync_dir(os.path.dirname(path) or ".")
+
+
+def atomic_write_json(path: str, obj, indent: int = 2, fsync: bool = True,
+                      before_replace: Optional[Callable[[], None]] = None
+                      ) -> None:
+    atomic_write_text(path, json.dumps(obj, indent=indent),
+                      fsync=fsync, before_replace=before_replace)
+
+
+def write_marker(path: str, fsync: bool = True) -> None:
+    """Create an empty completion marker (`_SUCCESS`) durably: the marker
+    must not become visible-and-durable before the data it vouches for,
+    so the directory is fsynced after creation."""
+    with open(path, "w"):
+        pass
+    if fsync:
+        fsync_file(path)
+        fsync_dir(os.path.dirname(path) or ".")
+
+
+def write_manifest(dirpath: str) -> None:
+    """Scan `dirpath` and write manifest.json LAST (tmp+rename+fsync):
+    the completeness marker a checkpoint resume verifies.  Every data
+    file is fsynced first so a verifying manifest implies durable
+    contents."""
+    files = {}
+    for root, _, names in os.walk(dirpath):
+        for fn in sorted(names):
+            if fn in ("manifest.json", "manifest.json.tmp"):
+                continue
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, dirpath)
+            fsync_file(p)
+            files[rel] = {"bytes": os.path.getsize(p),
+                          "sha256": file_sha256(p)}
+    atomic_write_json(os.path.join(dirpath, "manifest.json"),
+                      {"format_version": 1, "files": files}, indent=1)

@@ -27,6 +27,14 @@ work.  This module makes faults FIRST-CLASS and REPRODUCIBLE:
     `cli.train` maps `Preempted` to the distinct resumable exit status
     `EXIT_PREEMPTED` (75, EX_TEMPFAIL — "transient failure, retry").
 
+Every injection site is DECLARED in the `SITES` registry below (name ->
+declared context keys).  `FaultPlan` rejects unknown sites and unknown
+`match` keys at construction/install time, and photonlint rule PH004
+statically enforces that every `faults.fire(...)` call uses a literal,
+registered site name with declared context keys — a typo'd site or ctx
+key in an injection spec would otherwise arm a fault that silently never
+fires.
+
 Injection sites currently threaded (ctx keys in parentheses):
 
   stage.fetch       chunk staging host read        (chunk)
@@ -63,6 +71,22 @@ logger = logging.getLogger("photon_ml_tpu")
 
 #: Distinct resumable exit status for graceful preemption (EX_TEMPFAIL).
 EXIT_PREEMPTED = 75
+
+#: The central fault-site registry: site name -> the context keys its
+#: `fire(...)` call passes (what injection specs may `match` on).  Keep
+#: in sync with the docstring above — photonlint PH004 checks both
+#: directions (every fire() literal registered here, every entry here
+#: documented there).
+SITES: Dict[str, Tuple[str, ...]] = {
+    "stage.fetch": ("chunk",),
+    "stage.transfer": ("chunk",),
+    "mesh.stage": ("key", "field"),
+    "checkpoint.write": ("iteration",),
+    "checkpoint.fsync": ("iteration",),
+    "model.save": ("directory",),
+    "model.load": ("directory",),
+    "solve.poison": ("coordinate", "iteration"),
+}
 
 
 class FaultError(Exception):
@@ -131,7 +155,18 @@ class FaultSpec:
         self.hits = tuple(int(h) for h in self.hits)
 
     def matches(self, ctx: Dict[str, object]) -> bool:
-        return all(str(ctx.get(k)) == str(v) for k, v in self.match.items())
+        """Context filter.  A `match` key the site did not pass is an
+        ERROR, not a silent no-match: the old lenient behavior compared
+        against None and hid typo'd injection specs behind faults that
+        never fired."""
+        missing = [k for k in self.match if k not in ctx]
+        if missing:
+            raise ValueError(
+                f"fault spec for site {self.site!r} matches on context "
+                f"key(s) {missing} that the site did not pass "
+                f"(got {sorted(ctx)}); declared keys for the site live "
+                "in utils.faults.SITES")
+        return all(str(ctx[k]) == str(v) for k, v in self.match.items())
 
     def to_dict(self) -> dict:
         d = {"site": self.site, "action": self.action}
@@ -153,6 +188,20 @@ class FaultPlan:
     def __init__(self, specs, seed: int = 0):
         self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
                       for s in specs]
+        for s in self.specs:
+            if s.site not in SITES:
+                known = ", ".join(sorted(SITES))
+                raise ValueError(
+                    f"unknown fault site {s.site!r} — a plan naming an "
+                    "unregistered site would arm a fault that never "
+                    f"fires (known sites: {known}; new sites must be "
+                    "declared in utils.faults.SITES)")
+            bad = sorted(set(s.match) - set(SITES[s.site]))
+            if bad:
+                raise ValueError(
+                    f"fault spec for site {s.site!r} matches on unknown "
+                    f"context key(s) {bad}; the site passes "
+                    f"{list(SITES[s.site])} (see utils.faults.SITES)")
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()

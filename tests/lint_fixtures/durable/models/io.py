@@ -1,0 +1,9 @@
+"""PH005 fixture: bare writes in a module whose path marks it durable
+(suffix `models/io.py`) — a crash mid-write tears the metadata file."""
+import json
+import os
+
+
+def save_metadata(directory, meta):
+    with open(os.path.join(directory, "model-metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)

@@ -1,0 +1,15 @@
+"""PH005 near-misses: the atomic helper for writes, bare open() only for
+reads."""
+import json
+import os
+
+from photon_ml_tpu.utils.durable import atomic_write_json
+
+
+def save_metadata(directory, meta):
+    atomic_write_json(os.path.join(directory, "model-metadata.json"), meta)
+
+
+def load_metadata(directory):
+    with open(os.path.join(directory, "model-metadata.json")) as f:
+        return json.load(f)

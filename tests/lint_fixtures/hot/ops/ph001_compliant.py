@@ -1,0 +1,23 @@
+"""PH001 near-misses: everything here looks like the violation but is
+fine — host values, host->device transfers, static metadata, and the one
+designated flush point."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_side(cfg):
+    return float(cfg["tolerance"])  # plain host value
+
+
+def to_device(rows):
+    return jnp.asarray(np.asarray(rows))  # host -> device: not a sync
+
+
+def shape_metadata(x: jnp.ndarray):
+    return x.shape, x.ndim, x.dtype  # static, resolves without a fetch
+
+
+def flush(pending):  # photonlint: flush-point
+    # THE designated batched readback: one fetch for the whole iteration
+    return jax.device_get(pending)

@@ -1,0 +1,23 @@
+"""PH001 fixture: host syncs in a hot-path module (the `ops/` path
+component makes this file hot).  Four violations, one per sync spelling."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def objective_to_host(x):
+    v = jnp.dot(x, x)
+    return float(v)
+
+
+def item_sync(x: jnp.ndarray):
+    return x.item()
+
+
+def hidden_transfer(x):
+    y = jnp.exp(x)
+    return np.asarray(y)
+
+
+def unbatched_fetch(metrics):
+    return jax.device_get(metrics)

@@ -1,0 +1,30 @@
+"""PH002 near-misses: static branches, structural `is None` tests, shape
+metadata, traced `jnp.where` selection, and hashable static call args."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("use_l1",))
+def step(x, use_l1):
+    if use_l1:  # declared static: each value is its own compiled program
+        x = jnp.abs(x)
+    return x
+
+
+@jax.jit
+def masked(x, w):
+    if w is None:  # structural test, resolved at trace time
+        return x
+    n = x.shape[0]
+    if n > 3:  # shape metadata is static under the trace
+        return x * w
+    return jnp.where(w > 0, x, jnp.zeros_like(x))  # traced select
+
+
+select = jax.jit(lambda table, cols: table, static_argnums=(1,))
+
+
+def call_site(table):
+    return select(table, (0, 1))  # hashable tuple: caches cleanly

@@ -1,0 +1,28 @@
+"""PH002 fixture: retrace hazards — a Python branch and an f-string on
+traced values inside jit-wrapped functions, and a non-hashable literal in
+a static argument position at a call site."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, lr):
+    if lr > 0.5:
+        x = x * lr
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("tag",))
+def fmt(x, tag):
+    label = f"solve-{x}"
+    del label
+    return x
+
+
+select = jax.jit(lambda table, cols: table, static_argnums=(1,))
+
+
+def call_site(table):
+    return select(table, [0, 1])

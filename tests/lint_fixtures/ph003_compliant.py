@@ -1,0 +1,21 @@
+"""PH003 near-misses: the donate-and-rebind idiom, and the
+copy-before-donate guard (the copy, not the live buffer, is donated)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def axpy(x, g):
+    return x - 0.1 * g
+
+
+def run_rebound(x, g):
+    x = axpy(x, g)  # rebinding the name: the dead buffer is unreachable
+    return x + 1.0
+
+
+def run_copied(x, g):
+    y = axpy(jnp.array(x, copy=True), g)  # copy-on-alias guard
+    return y + jnp.sum(x)

@@ -1,0 +1,16 @@
+"""PH003 fixture: `x` is read after being passed in a donate_argnums
+position — the buffer was invalidated by the donating call."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def axpy(x, g):
+    return x - 0.1 * g
+
+
+def run(x, g):
+    y = axpy(x, g)
+    return y + jnp.sum(x)

@@ -1,0 +1,12 @@
+"""PH004 near-misses: registered literal sites with declared context
+keys, through both the module-attribute and from-import spellings."""
+from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.faults import fire
+
+
+def stage(i):
+    faults.fire("stage.fetch", chunk=i)
+
+
+def save(directory):
+    fire("model.save", directory=directory)

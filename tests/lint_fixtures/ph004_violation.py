@@ -1,0 +1,9 @@
+"""PH004 fixture: an undeclared site, a dynamic site name, and an
+undeclared context key (all checked against utils.faults.SITES)."""
+from photon_ml_tpu.utils import faults
+
+
+def stage(i, site_name):
+    faults.fire("stage.bogus", chunk=i)
+    faults.fire(site_name, chunk=i)
+    faults.fire("stage.fetch", chunk_index=i)

@@ -1,0 +1,17 @@
+"""PH006 near-misses: keyed jax.random inside the trace (deterministic),
+host timing outside it."""
+import time
+
+import jax
+from jax import random as jrandom
+
+
+@jax.jit
+def stochastic(x, key):
+    return x + jrandom.normal(key, x.shape)
+
+
+def timed(fn, x):
+    t0 = time.perf_counter()
+    y = fn(x)
+    return y, time.perf_counter() - t0

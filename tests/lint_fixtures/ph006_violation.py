@@ -1,0 +1,11 @@
+"""PH006 fixture: host wall-clock and host RNG inside a jit-wrapped
+function — both freeze at trace time."""
+import random
+import time
+
+import jax
+
+
+@jax.jit
+def noisy(x):
+    return x * random.random() + time.time()

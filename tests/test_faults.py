@@ -73,27 +73,32 @@ def _config(iters=3, coords=("fixed", "perUser")):
 
 def test_fault_plan_hits_and_match():
     plan = faults.FaultPlan([
-        {"site": "s", "action": "transient", "hits": [2],
+        {"site": "solve.poison", "action": "transient", "hits": [2],
          "match": {"coordinate": "a"}}])
     with faults.injected(plan):
-        assert faults.fire("s", coordinate="b") is None  # no match
-        assert faults.fire("s", coordinate="a") is None  # hit 1
+        assert faults.fire("solve.poison", coordinate="b",
+                           iteration=0) is None  # no match
+        assert faults.fire("solve.poison", coordinate="a",
+                           iteration=0) is None  # hit 1
         with pytest.raises(faults.TransientFault):
-            faults.fire("s", coordinate="a")             # hit 2 fires
-        assert faults.fire("s", coordinate="a") is None  # hit 3
+            faults.fire("solve.poison", coordinate="a",
+                        iteration=1)             # hit 2 fires
+        assert faults.fire("solve.poison", coordinate="a",
+                           iteration=2) is None  # hit 3
     rep = plan.report()
-    assert rep["sites"]["s"] == {"calls": 3, "fired": 1}
+    assert rep["sites"]["solve.poison"] == {"calls": 3, "fired": 1}
     assert rep["total_fired"] == 1
 
 
 def test_fault_plan_probability_is_seeded():
     def fires(seed):
         plan = faults.FaultPlan(
-            [{"site": "s", "probability": 0.5, "max_fires": 100}], seed=seed)
+            [{"site": "stage.fetch", "probability": 0.5,
+              "max_fires": 100}], seed=seed)
         out = []
         for i in range(50):
             try:
-                plan.fire("s")
+                plan.fire("stage.fetch", chunk=i)
                 out.append(False)
             except faults.TransientFault:
                 out.append(True)
@@ -131,9 +136,36 @@ def test_transient_classification():
 
 def test_unknown_action_rejected():
     with pytest.raises(ValueError, match="unknown fault action"):
-        faults.FaultPlan([{"site": "s", "action": "explode", "hits": [1]}])
+        faults.FaultPlan([{"site": "stage.fetch", "action": "explode",
+                           "hits": [1]}])
     with pytest.raises(ValueError, match="never fires"):
-        faults.FaultPlan([{"site": "s"}])
+        faults.FaultPlan([{"site": "stage.fetch"}])
+
+
+def test_unknown_site_rejected_at_install_time():
+    # a typo'd site would arm a fault that silently never fires — the
+    # registry (utils.faults.SITES) rejects it up front, by name
+    with pytest.raises(ValueError, match="unknown fault site 'stage.ftch'"):
+        faults.FaultPlan([{"site": "stage.ftch", "hits": [1]}])
+    with pytest.raises(ValueError, match="stage.fetch"):  # helpful listing
+        faults.FaultPlan([{"site": "nope", "hits": [1]}])
+
+
+def test_unknown_match_key_rejected_at_install_time():
+    with pytest.raises(ValueError, match=r"unknown context key\(s\) "
+                                         r"\['chunk_index'\]"):
+        faults.FaultPlan([{"site": "stage.fetch", "hits": [1],
+                           "match": {"chunk_index": 3}}])
+
+
+def test_match_key_missing_from_fire_context_is_an_error():
+    # the site declares the key but the fire() call didn't pass it: that
+    # is a real bug at the site, not a silent no-match
+    plan = faults.FaultPlan([{"site": "solve.poison", "hits": [1],
+                              "match": {"coordinate": "a"}}])
+    with faults.injected(plan):
+        with pytest.raises(ValueError, match="did not pass"):
+            faults.fire("solve.poison", iteration=0)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,217 @@
+"""photonlint: per-rule fixture tests, suppression round-trips, baseline
+workflow, CLI contract, and the tier-1 self-check that the shipped
+package lints clean against the committed baseline.
+
+Each rule PH001–PH006 is demonstrated by one minimal violating fixture
+and one compliant near-miss fixture (tests/lint_fixtures/); the
+suppression test rewrites every flagged line with its `# photonlint:
+disable=...` comment and asserts the findings vanish — proving both that
+the rule fires and that its suppression works.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+import photon_ml_tpu
+from photon_ml_tpu.analysis.engine import Baseline, lint_paths
+from photon_ml_tpu.analysis.lint import DEFAULT_BASELINE, main as lint_main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+PACKAGE_DIR = os.path.dirname(os.path.abspath(photon_ml_tpu.__file__))
+
+# (rule, violating fixture, compliant near-miss fixture, finding count)
+CASES = [
+    ("PH001", "hot/ops/ph001_violation.py",
+     "hot/ops/ph001_compliant.py", 4),
+    ("PH002", "ph002_violation.py", "ph002_compliant.py", 3),
+    ("PH003", "ph003_violation.py", "ph003_compliant.py", 1),
+    ("PH004", "ph004_violation.py", "ph004_compliant.py", 3),
+    ("PH005", "durable/models/io.py", "durable_ok/models/io.py", 2),
+    ("PH006", "ph006_violation.py", "ph006_compliant.py", 2),
+]
+
+
+def _lint(path, **kw):
+    return lint_paths([os.path.join(FIXTURES, path)], **kw)
+
+
+# --------------------------------------------------------------------------
+# per-rule fixtures
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule,violation,compliant,count",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_fires_on_violation_fixture(rule, violation, compliant,
+                                         count):
+    findings = _lint(violation)
+    assert [f.rule for f in findings] == [rule] * count
+    assert all(f.line > 0 and f.col > 0 and f.text for f in findings)
+
+
+@pytest.mark.parametrize("rule,violation,compliant,count",
+                         CASES, ids=[c[0] for c in CASES])
+def test_rule_quiet_on_compliant_near_miss(rule, violation, compliant,
+                                           count):
+    assert _lint(compliant) == []
+
+
+@pytest.mark.parametrize("rule,violation,compliant,count",
+                         CASES, ids=[c[0] for c in CASES])
+def test_line_suppression_silences_each_finding(rule, violation,
+                                                compliant, count,
+                                                tmp_path):
+    src_path = os.path.join(FIXTURES, violation)
+    findings = lint_paths([src_path])
+    lines = open(src_path, encoding="utf-8").read().splitlines()
+    for f in findings:
+        lines[f.line - 1] += f"  # photonlint: disable={rule}"
+    # mirror the fixture's subpath so path-gated rules (hot-path dirs,
+    # durable-module suffixes) still classify the file the same way
+    dest = tmp_path / violation
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    dest.write_text("\n".join(lines) + "\n")
+    assert lint_paths([str(dest)]) == []
+
+
+def test_file_level_suppression(tmp_path):
+    src = open(os.path.join(FIXTURES, "hot/ops/ph001_violation.py"),
+               encoding="utf-8").read()
+    dest = tmp_path / "hot" / "ops" / "mod.py"
+    dest.parent.mkdir(parents=True)
+    dest.write_text("# photonlint: disable-file=PH001\n" + src)
+    assert lint_paths([str(dest)]) == []
+
+
+def test_ph001_is_hot_path_scoped(tmp_path):
+    # the same syncs OUTSIDE ops/optim/game/parallel/serving are fine:
+    # cold paths may sync freely
+    shutil.copy(os.path.join(FIXTURES, "hot/ops/ph001_violation.py"),
+                tmp_path / "coldpath.py")
+    assert lint_paths([str(tmp_path / "coldpath.py")]) == []
+
+
+def test_ph005_is_durable_module_scoped(tmp_path):
+    shutil.copy(os.path.join(FIXTURES, "durable/models/io.py"),
+                tmp_path / "scratch_writer.py")
+    assert lint_paths([str(tmp_path / "scratch_writer.py")]) == []
+
+
+def test_select_filters_rules():
+    findings = _lint("hot/ops/ph001_violation.py", select=["PH005"])
+    assert findings == []
+
+
+def test_ph004_registry_docs_drift(tmp_path):
+    # when the linted tree carries its own faults.py registry, every
+    # SITES entry must appear in the module docstring
+    (tmp_path / "faults.py").write_text(
+        '"""Docs mention stage.fetch only."""\n'
+        'SITES = {"stage.fetch": ("chunk",),\n'
+        '         "undocumented.site": ()}\n')
+    findings = lint_paths([str(tmp_path / "faults.py")])
+    assert [f.rule for f in findings] == ["PH004"]
+    assert "undocumented.site" in findings[0].message
+
+
+def test_unparseable_module_is_reported_not_fatal(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    findings = lint_paths([str(tmp_path / "broken.py")])
+    assert [f.rule for f in findings] == ["PH000"]
+
+
+# --------------------------------------------------------------------------
+# baseline workflow
+# --------------------------------------------------------------------------
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    viol = os.path.join(FIXTURES, "hot/ops/ph001_violation.py")
+    baseline_path = str(tmp_path / "baseline.json")
+    rc = lint_main([viol, "--baseline", baseline_path,
+                    "--write-baseline"])
+    assert rc == 0
+    # all findings grandfathered -> clean exit
+    assert lint_main([viol, "--baseline", baseline_path]) == 0
+    # --no-baseline still reports them
+    assert lint_main([viol, "--no-baseline"]) == 1
+    # baseline identity survives line drift but not text changes
+    findings = lint_paths([viol])
+    baseline = Baseline.load(baseline_path)
+    new, old, stale = baseline.split(findings)
+    assert not new and len(old) == len(findings) and stale == 0
+
+
+def test_baseline_multiset_matching(tmp_path):
+    # two identical violating lines need two baseline entries
+    dest = tmp_path / "hot" / "ops" / "twice.py"
+    dest.parent.mkdir(parents=True)
+    dest.write_text("import jax.numpy as jnp\n"
+                    "def f(x):\n"
+                    "    return float(jnp.sum(x))\n"
+                    "def g(x):\n"
+                    "    return float(jnp.sum(x))\n")
+    findings = lint_paths([str(dest)])
+    assert len(findings) == 2
+    baseline = Baseline([findings[0].to_dict()
+                         | {"path": findings[0].baseline_path}])
+    new, old, stale = baseline.split(findings)
+    assert len(new) == 1 and len(old) == 1
+
+
+# --------------------------------------------------------------------------
+# CLI contract (standalone / CI usage)
+# --------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.analysis.lint", *args],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_cli_json_output_and_exit_codes():
+    bad = _run_cli("tests/lint_fixtures/hot/ops/ph001_violation.py",
+                   "--no-baseline", "--json")
+    assert bad.returncode == 1
+    report = json.loads(bad.stdout)
+    assert report["counts"]["new"] == 4
+    assert {f["rule"] for f in report["findings"]} == {"PH001"}
+    assert all(not f["baselined"] for f in report["findings"])
+
+    ok = _run_cli("tests/lint_fixtures/hot/ops/ph001_compliant.py",
+                  "--no-baseline", "--json")
+    assert ok.returncode == 0
+    assert json.loads(ok.stdout)["counts"]["new"] == 0
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("PH001", "PH002", "PH003", "PH004", "PH005", "PH006"):
+        assert rule_id in out
+
+
+# --------------------------------------------------------------------------
+# tier-1 gate: the shipped tree lints clean against the baseline
+# --------------------------------------------------------------------------
+
+def test_package_lints_clean_against_baseline():
+    findings = lint_paths([PACKAGE_DIR])
+    baseline = Baseline.load(DEFAULT_BASELINE)
+    new, _, _ = baseline.split(findings)
+    assert new == [], ("photonlint found non-baseline violations:\n"
+                       + "\n".join(f.render() for f in new))
+
+
+def test_baseline_stays_small():
+    # acceptance: <= 5 grandfathered findings, and it should only shrink
+    baseline = Baseline.load(DEFAULT_BASELINE)
+    assert baseline.total <= 5
+
+
+def test_linter_package_lints_itself_clean():
+    analysis_dir = os.path.join(PACKAGE_DIR, "analysis")
+    assert lint_paths([analysis_dir]) == []
