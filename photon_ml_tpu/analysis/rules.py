@@ -1,4 +1,4 @@
-"""photonlint rule catalog (PH001–PH006).
+"""photonlint rule catalog (PH001–PH007).
 
 Each rule is a class with an `rule_id`, a one-line `summary` (the `--list-
 rules` catalog), and `check(ctx) -> Iterable[Finding]` over an
@@ -546,6 +546,40 @@ class NondeterminismRule(Rule):
         return findings
 
 
+# -- PH007: raw span timing in hot-path modules -------------------------------
+
+class RawTimerRule(Rule):
+    rule_id = "PH007"
+    name = "raw-timer"
+    summary = ("raw time.perf_counter() span timing in hot-path modules — "
+               "route through telemetry (PhaseTimings.span/blocked or "
+               "telemetry.timings.clock) so every phase lands in ONE "
+               "trace, not a private stopwatch")
+
+    _TIMERS = ("time.perf_counter", "time.perf_counter_ns")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        # telemetry/ is the sanctioned implementation and is not a
+        # hot-path directory, so it is exempt by scoping
+        if not ctx.is_hot_path:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            origin = ctx.resolve(node.func)
+            if origin in self._TIMERS:
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    f"{origin}() span timing in a hot-path module — time "
+                    "phases through telemetry (PhaseTimings.span / "
+                    ".blocked, or telemetry.timings.clock) so the span "
+                    "lands in the unified trace instead of a bespoke "
+                    "counter the bench can't correlate"))
+        return findings
+
+
 def all_rules() -> List[Rule]:
     return [HostSyncRule(), RetraceHazardRule(), DonationSafetyRule(),
-            FaultSiteRule(), DurableWriteRule(), NondeterminismRule()]
+            FaultSiteRule(), DurableWriteRule(), NondeterminismRule(),
+            RawTimerRule()]

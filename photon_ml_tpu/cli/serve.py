@@ -11,7 +11,9 @@ HTTP mode (default) — a dependency-free stdlib server:
   POST /score    {"features": {shard: [[...]]}, "ids": {type: [...]},
                   "timeout_ms": 50}        -> {"scores": [...]}
   POST /predict  same body                 -> {"predictions": [...]}
-  GET  /metrics                            -> ServingMetrics snapshot
+  GET  /metrics                            -> Prometheus text exposition
+                                              (0.0.4; scrape this)
+  GET  /metrics.json                       -> ServingMetrics JSON snapshot
   POST /swap     {"model_dir": "..."}      -> zero-downtime hot swap
   POST /rollback                           -> previous version
   GET  /healthz
@@ -186,8 +188,22 @@ def _make_http_server(service, host: str, port: int):
                 return {}
             return json.loads(self.rfile.read(length) or b"{}")
 
+        def _reply_text(self, code: int, body: str, content_type: str):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
             if self.path == "/metrics":
+                # Prometheus scrape endpoint (text exposition 0.0.4); the
+                # JSON snapshot moved to /metrics.json
+                self._reply_text(
+                    200, service.prometheus_metrics(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/metrics.json":
                 self._reply(200, service.metrics_snapshot())
             elif self.path == "/healthz":
                 self._reply(200, {
@@ -264,8 +280,8 @@ def main(argv=None) -> int:
         "model_version": service.model_version,
         "model_load_s": round(load_s, 3),
         "buckets": service.registry.scorer.bucket_sizes(),
-        "endpoints": ["/score", "/predict", "/metrics", "/swap",
-                      "/rollback", "/healthz"],
+        "endpoints": ["/score", "/predict", "/metrics", "/metrics.json",
+                      "/swap", "/rollback", "/healthz"],
     }), flush=True)
     try:
         httpd.serve_forever(poll_interval=0.2)

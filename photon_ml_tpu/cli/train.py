@@ -135,6 +135,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "into <output-dir>/profile (the TPU-native "
                         "replacement for the reference's Timed/Spark event "
                         "log; view with TensorBoard or xprof)")
+    p.add_argument("--trace-out", default=None, metavar="TRACE.json",
+                   help="arm the telemetry span tracer and write a Chrome-"
+                        "trace/Perfetto JSON timeline of the fit (outer "
+                        "iterations -> coordinate visits -> solves / chunk "
+                        "staging / checkpoint writes, with fault/"
+                        "quarantine/recovery events attached to their "
+                        "spans); open at https://ui.perfetto.dev.  "
+                        "Disarmed (the default) the instrumentation is a "
+                        "module-global None check — zero overhead")
+    p.add_argument("--run-log", default=None, metavar="RUN.jsonl",
+                   help="JSONL run log: one line per finished span and "
+                        "instant event (EventEmitter events, fault "
+                        "injections, quarantine rollbacks, checkpoint "
+                        "recoveries), correlated by span id with "
+                        "--trace-out; arms the tracer like --trace-out")
     p.add_argument("--no-compile-cache", action="store_true",
                    help="disable the persistent XLA compilation cache (on "
                         "by default so repeat invocations skip compiles; "
@@ -423,6 +438,16 @@ def _run(args, log) -> int:
         log.warning("fault plan ACTIVE from --fault-plan: %d spec(s)",
                     len(fault_plan.specs))
 
+    # telemetry (photon_ml_tpu/telemetry): the span tracer arms only when
+    # a timeline was asked for — disarmed it is a module-global None check
+    # on every instrumented path.  The metrics registry is always live.
+    from photon_ml_tpu import telemetry
+    tracer = None
+    if args.trace_out or args.run_log:
+        tracer = telemetry.install(run_log=args.run_log)
+        log.info("telemetry armed: trace_out=%s run_log=%s",
+                 args.trace_out, args.run_log)
+
     # persistent compile cache + honest compile accounting (the reference
     # pays no compile cost — JVM/Breeze interprets; a warm cache is our
     # equivalent posture, and compile_s in the summary proves it worked)
@@ -693,6 +718,11 @@ def _run(args, log) -> int:
             "compile_s": round(compile_tracker.seconds, 2),
             "compile_count": compile_tracker.count,
             "compile_cache": cache_dir,
+            # the unified telemetry surface: registry counters/gauges/
+            # histograms (stream/mesh/checkpoint/quarantine/retrace
+            # accounting) + tracer record counts when armed
+            "telemetry": telemetry.snapshot(),
+            "trace_out": args.trace_out,
             "output": os.path.join(args.output_dir, "best"),
         }
         with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
@@ -739,6 +769,19 @@ def _run(args, log) -> int:
         preempt_guard.__exit__(None, None, None)
         if profile_ctx is not None:
             profile_ctx.__exit__(None, None, None)
+        if tracer is not None:
+            # export on EVERY path (success, preemption, failure): a
+            # timeline of the run that died is the one you want most
+            telemetry.shutdown()
+            if args.trace_out:
+                try:
+                    info = telemetry.write_chrome_trace(args.trace_out)
+                    log.info("chrome trace written: %s", info)
+                    print(f"trace written to {args.trace_out} "
+                          f"({info['events']} events) — open at "
+                          "https://ui.perfetto.dev", file=sys.stderr)
+                except Exception:
+                    log.exception("trace export failed")
         # listeners flush buffered events in close() — run even when
         # training/validation/tuning raises
         if emitter is not None:

@@ -37,6 +37,7 @@ from typing import Callable, Dict, Iterator, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.utils import faults
 from photon_ml_tpu.utils.math import ceil_pow2
 
@@ -191,10 +192,12 @@ class StreamStats:
     def note_retry(self) -> None:
         with self._lock:
             self.retries += 1
+        telemetry.counter("stream.retries").inc()
 
     def note_gave_up(self) -> None:
         with self._lock:
             self.gave_up += 1
+        telemetry.counter("stream.gave_up").inc()
 
     def note_staged(self, nbytes: int) -> None:
         with self._lock:
@@ -206,6 +209,10 @@ class StreamStats:
                                             self.resident_chunks)
             self.peak_resident_bytes = max(self.peak_resident_bytes,
                                            self.resident_bytes)
+        # process-global mirror (telemetry.snapshot() aggregates every
+        # Prefetcher; per-instance numbers stay on this object)
+        telemetry.counter("stream.staged_bytes").inc(nbytes)
+        telemetry.counter("stream.chunks_staged").inc()
 
     def note_released(self, nbytes: int) -> None:
         with self._lock:
@@ -314,6 +321,9 @@ class Prefetcher:
                         f"{self.plan.num_chunks} after {attempt} "
                         f"attempt(s)", spec.index) from e
                 self.stats.note_retry()
+                telemetry.event("stage_retry", chunk=spec.index,
+                                attempt=attempt,
+                                error=f"{type(e).__name__}: {e}")
                 # exponential backoff with jitter so concurrent streams
                 # don't re-hammer a struggling source in lockstep
                 delay = (self.backoff_s * (2 ** (attempt - 1))
@@ -341,7 +351,11 @@ class Prefetcher:
                             return
                     if cancel.is_set():
                         return
-                    dev = self._stage_with_retry(spec, jitter)
+                    # span on the PREFETCH thread: staging gets its own
+                    # track in the trace, overlapping the consumer's solve
+                    with telemetry.span("stage", chunk=spec.index,
+                                        rows=spec.rows):
+                        dev = self._stage_with_retry(spec, jitter)
                     self.stats.note_staged(_tree_nbytes(dev))
                     q.put((spec, dev))
                 q.put(_DONE)

@@ -15,25 +15,25 @@ that exists — arrays are device-resident for the whole fit.
 """
 from __future__ import annotations
 
-import contextlib
 import dataclasses
 import functools
 import logging
 import math
 import os
-import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.evaluation.evaluators import Evaluator, MultiEvaluator
 from photon_ml_tpu.game import quarantine as quarantine_mod
 from photon_ml_tpu.game.coordinates import Coordinate
 from photon_ml_tpu.models.game import GameModel
 from photon_ml_tpu.ops import TASK_LOSSES
+from photon_ml_tpu.telemetry.timings import PhaseTimings, clock
 from photon_ml_tpu.utils import faults
 from photon_ml_tpu.utils import durable
 
@@ -63,42 +63,10 @@ class ValidationSpec:
         return self.evaluator(s, dataset.response, dataset.weights)
 
 
-class PhaseTimings(dict):
-    """Accumulating span timer (reference: Timer/Timed spans at every driver
-    stage, photon-lib/.../util/Timer.scala:32-234 used ~30x).  Spans are
-    CONTIGUOUS over the descent loop so their sum accounts for the whole
-    fit wall-clock — an unattributed gap means an untimed stage, which is
-    exactly what round 3's bench suffered from.
-
-    `host_blocked` tracks, per span label, the seconds the host spent
-    BLOCKED on device readbacks (scalar syncs, `float()` objective fetches,
-    [n]-array transfers into numpy evaluators, the pipelined boundary
-    flush).  host_blocked_total()/wall is the host-blocked fraction bench
-    reports per config — the quantity pipelining exists to shrink."""
-
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        self.host_blocked: Dict[str, float] = {}
-
-    @contextlib.contextmanager
-    def span(self, label: str, host_blocked: bool = False):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self[label] = self.get(label, 0.0) + dt
-            if host_blocked:
-                self.add_blocked(label, dt)
-
-    def add_blocked(self, label: str, seconds: float) -> None:
-        self.host_blocked[label] = self.host_blocked.get(label, 0.0) + seconds
-
-    def host_blocked_total(self) -> float:
-        return float(sum(self.host_blocked.values()))
-
-    def total(self) -> float:
-        return float(sum(self.values()))
+# PhaseTimings lives in telemetry/timings.py now (photonlint PH007: hot
+# modules route span timing through telemetry); re-imported above so
+# `from photon_ml_tpu.game.coordinate_descent import PhaseTimings` keeps
+# working for bench.py and the tests.
 
 
 @functools.partial(jax.jit, static_argnames=("loss",))
@@ -119,11 +87,11 @@ def _sync(*arrays) -> float:  # photonlint: flush-point
     a device->host readback orders the timeline, so every STRICT-mode
     timing span that launches device work ends with one (cost: one [1]
     DMA).  Pipelined mode skips these entirely — that is the point."""
-    t0 = time.perf_counter()
+    t0 = clock()
     for a in arrays:
         if a is not None and hasattr(a, "ravel"):
             float(jnp.asarray(a).ravel()[-1])
-    return time.perf_counter() - t0
+    return clock() - t0
 
 
 @dataclasses.dataclass
@@ -153,6 +121,11 @@ class TrackerSummary:
     # must stage ZERO cold bytes — bench --mesh and the transfer
     # regression test gate on this.  None on non-mesh fits.
     staged_bytes: Optional[Dict[str, int]] = None
+    # fresh XLA traces observed during THIS visit (telemetry's compile
+    # watch, the runtime counterpart of photonlint PH002): a warm fit must
+    # show 0 everywhere.  None when the tracer is disarmed (the counter
+    # only advances while the compile watch is armed).
+    retraces: Optional[int] = None
 
 
 def _reason_counts(reason) -> Dict[str, int]:
@@ -220,8 +193,10 @@ class CoordinateDescentResult:
     def solver_diagnostics(self) -> Dict[str, dict]:
         """Per-coordinate solver totals for the fit summary: solve count,
         inner iterations actually used, ConvergenceReason outcome counts,
-        and the budget trajectory (iteration caps per visit, None entries =
-        strict full solves).  reference: the per-update
+        the budget trajectory (iteration caps per visit, None entries =
+        strict full solves), host-blocked seconds attributed to the
+        coordinate's spans, and — when the telemetry compile watch was
+        armed — fresh traces per coordinate.  reference: the per-update
         OptimizationStatesTracker logs the GAME driver prints."""
         out: Dict[str, dict] = {}
         for key, t in sorted(self.trackers.items(),
@@ -230,13 +205,16 @@ class CoordinateDescentResult:
             coord = key.split("/", 1)[1]
             d = out.setdefault(coord, {"solves": 0, "iterations": 0,
                                        "reasons": {}, "iteration_caps": [],
-                                       "containment": {}})
+                                       "containment": {},
+                                       "host_blocked_s": 0.0})
             d["solves"] += 1
             d["iterations"] += t.iterations
             d["iteration_caps"].append(t.iteration_cap)
             if t.containment is not None:
                 d["containment"][t.containment] = \
                     d["containment"].get(t.containment, 0) + 1
+            if t.retraces is not None:
+                d["retraces"] = d.get("retraces", 0) + t.retraces
             for name, c in t.reasons.items():
                 d["reasons"][name] = d["reasons"].get(name, 0) + c
             if t.staged_bytes is not None:
@@ -244,6 +222,14 @@ class CoordinateDescentResult:
                                   {"cold": 0, "warm": 0})
                 sb["cold"] += t.staged_bytes.get("cold", 0)
                 sb["warm"] += t.staged_bytes.get("warm", 0)
+        # host-blocked attribution: span labels are "{it}/{coord}/{phase}"
+        blocked = getattr(self.timings, "host_blocked", None) or {}
+        for label, seconds in blocked.items():
+            parts = label.split("/")
+            if len(parts) == 3 and parts[1] in out:
+                out[parts[1]]["host_blocked_s"] += seconds
+        for d in out.values():
+            d["host_blocked_s"] = round(d["host_blocked_s"], 4)
         return out
 
 
@@ -415,6 +401,7 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
                 "directory %s; refusing to prune it", old, directory)
             continue
         shutil.rmtree(real, ignore_errors=True)
+    telemetry.counter("checkpoint.written").inc()
     logger.info("checkpoint: iteration %d saved to %s", iteration, path)
 
 
@@ -475,6 +462,7 @@ class AsyncCheckpointer:
                 raise RuntimeError("AsyncCheckpointer already shut down")
             if self._pending is not None:
                 self.coalesced += 1
+                telemetry.counter("checkpoint.coalesced").inc()
             self._pending = snap
             self._cv.notify_all()
 
@@ -488,7 +476,10 @@ class AsyncCheckpointer:
                 snap, self._pending = self._pending, None
                 self._busy = True
             try:
-                _write_checkpoint(self.directory, *snap)
+                # the span runs on THIS background thread: checkpoint
+                # serialization gets its own track in the trace
+                with telemetry.span("checkpoint_write", iteration=snap[0]):
+                    _write_checkpoint(self.directory, *snap)
                 with self._cv:
                     self.written += 1
             except BaseException as e:  # surfaced at submit/shutdown
@@ -614,6 +605,19 @@ def _state_to_checkpoint(directory: str, state: dict, relative: bool,
         return None
 
 
+def _note_recovery(recovery: dict) -> None:
+    """Publish a successful checkpoint recovery: counters always, a run-log
+    event when the tracer is armed (correlated by span id with whatever
+    stage triggered the resume)."""
+    telemetry.counter("checkpoint.recoveries").inc()
+    if recovery.get("fallback"):
+        telemetry.counter("checkpoint.recovery_fallbacks").inc()
+    telemetry.event(
+        "checkpoint_recovery", fallback=recovery.get("fallback"),
+        resumed_from_iteration=recovery.get("resumed_from_iteration"),
+        pruned=len(recovery.get("pruned") or ()))
+
+
 def _fingerprint_mismatch(state: dict, fingerprint: Optional[str],
                           directory: str) -> bool:
     recorded = state.get("config_fingerprint")
@@ -687,6 +691,7 @@ def read_checkpoint(directory: str,
                         if p}
                 result.recovery["pruned"] += _prune_orphan_dirs(directory,
                                                                 keep)
+                _note_recovery(result.recovery)
                 return result
             logger.warning("checkpoint at %s: primary record unusable; "
                            "trying verified fallback", directory)
@@ -728,6 +733,7 @@ def read_checkpoint(directory: str,
             "checkpoint at %s: fell back to verified record %s "
             "(completed_iterations=%d)", directory, os.path.basename(p),
             result.completed_iterations)
+        _note_recovery(result.recovery)
         return result
 
     if state is not None or _checkpoint_record_dirs(directory):
@@ -1045,6 +1051,7 @@ def run_coordinate_descent(
             trackers[key].containment = ("rolled_back" if not healthy
                                          else p["containment"])
             trackers[key].staged_bytes = p["staged"]
+            trackers[key].retraces = p["retraces"]
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
                         p["it"], p["name"], obj, spans[p["solve_key"]])
             for k, (spec, v) in enumerate(zip(validation_specs, metric_vals)):
@@ -1084,7 +1091,17 @@ def run_coordinate_descent(
     loop_ok = False
     try:
         for it in range(start_iteration, num_iterations):
+            # hierarchy level 1 of the trace: outer_iteration ->
+            # coordinate_visit -> solve/objective/validation spans.
+            # push/pop instead of `with` keeps the loop body un-reindented;
+            # an exception path (Preempted, a fatal staging error) leaves
+            # them open and Tracer.finish() heals them at export.
+            _it_span = telemetry.push("outer_iteration", iteration=it)
             for name in updating_sequence:
+                _visit_span = telemetry.push("coordinate_visit",
+                                             coordinate=name, iteration=it)
+                _retr0 = (telemetry.retrace_count() if telemetry.armed()
+                          else None)
                 solve_key = f"{it}/{name}/solve"
                 coord = coordinates[name]
                 frozen = monitor.is_frozen(name)
@@ -1100,7 +1117,8 @@ def run_coordinate_descent(
                     budget_diag = sched.plan(it, num_iterations,
                                              base.max_iterations,
                                              base.tolerance)
-                with spans.span(solve_key):
+                with spans.span(solve_key, name="solve", coordinate=name,
+                                iteration=it):
                     if frozen:
                         # quarantined after repeated divergence: the
                         # coordinate keeps its last good coefficients and
@@ -1149,7 +1167,8 @@ def run_coordinate_descent(
                         trackers[f"{it}/{name}"].containment = "frozen"
 
                 obj_key = f"{it}/{name}/objective"
-                with spans.span(obj_key):
+                with spans.span(obj_key, name="objective", coordinate=name,
+                                iteration=it):
                     if not frozen:
                         reg_terms[name] = coord.regularization_term(
                             models[name])
@@ -1158,9 +1177,8 @@ def run_coordinate_descent(
                                   quarantine_mod.combine_health(health_flag,
                                                                 obj_dev))
                     if not pipelined:
-                        t0 = time.perf_counter()
-                        obj = float(obj_dev)
-                        spans.add_blocked(obj_key, time.perf_counter() - t0)
+                        with spans.blocked(obj_key):
+                            obj = float(obj_dev)
                 if not pipelined:
                     healthy = (health_dev is True
                                # strict timing mode syncs per update BY
@@ -1180,7 +1198,8 @@ def run_coordinate_descent(
                 metrics: Dict[str, object] = {}
                 if do_validation:
                     val_key = f"{it}/{name}/validation"
-                    with spans.span(val_key):
+                    with spans.span(val_key, name="validation",
+                                    coordinate=name, iteration=it):
                         val_scores_by_coord[name] = \
                             models[name].score_dataset(validation_dataset)
                         val_scores = sum(val_scores_by_coord.values(),
@@ -1191,17 +1210,13 @@ def run_coordinate_descent(
                                 if v is None:
                                     # no device kernel (grouped/custom):
                                     # host fallback, one timed [n] transfer
-                                    t0 = time.perf_counter()
-                                    s_np = np.asarray(val_scores)
-                                    spans.add_blocked(
-                                        val_key, time.perf_counter() - t0)
+                                    with spans.blocked(val_key):
+                                        s_np = np.asarray(val_scores)
                                     v = spec.evaluate(validation_dataset, s_np)
                                 metrics[spec.name] = v
                         else:
-                            t0 = time.perf_counter()
-                            s_np = np.asarray(val_scores)
-                            spans.add_blocked(val_key,
-                                              time.perf_counter() - t0)
+                            with spans.blocked(val_key):
+                                s_np = np.asarray(val_scores)
                             vals = [spec.evaluate(validation_dataset, s_np)
                                     for spec in validation_specs]
                     if not pipelined:
@@ -1226,8 +1241,15 @@ def run_coordinate_descent(
                     # consumers finish.
                     residency.after_update(name)
                 staged = _staged_delta(mesh_before)
-                if not pipelined and staged is not None:
-                    trackers[f"{it}/{name}"].staged_bytes = staged
+                # fresh traces during this visit (tracing happens at
+                # dispatch time, so the count is settled HERE even in
+                # pipelined mode — nothing below launches device work)
+                retraces = (telemetry.retrace_count() - _retr0
+                            if _retr0 is not None else None)
+                if not pipelined:
+                    if staged is not None:
+                        trackers[f"{it}/{name}"].staged_bytes = staged
+                    trackers[f"{it}/{name}"].retraces = retraces
                 if pipelined:
                     pending.append({"it": it, "name": name,
                                     "solve_key": solve_key,
@@ -1238,8 +1260,10 @@ def run_coordinate_descent(
                                     "health": health_dev,
                                     "prev_model": prev_model,
                                     "staged": staged,
+                                    "retraces": retraces,
                                     "containment": ("frozen" if frozen
                                                     else None)})
+                telemetry.pop(_visit_span)
 
                 if faults.preemption_requested() \
                         and name != updating_sequence[-1]:
@@ -1248,7 +1272,8 @@ def run_coordinate_descent(
                     # newest durable record covers the completed
                     # iterations — this partial iteration retrains)
                     if pipelined:
-                        with spans.span(f"{it}/flush", host_blocked=True):
+                        with spans.span(f"{it}/flush", host_blocked=True,
+                                        name="flush", iteration=it):
                             flush_pending()
                     _preempt(it)
 
@@ -1256,11 +1281,13 @@ def run_coordinate_descent(
                 # outer-iteration boundary: the ONE host sync of the
                 # iteration (Snap ML-style pipelining: everything above was
                 # enqueued without waiting)
-                with spans.span(f"{it}/flush", host_blocked=True):
+                with spans.span(f"{it}/flush", host_blocked=True,
+                                name="flush", iteration=it):
                     flush_pending()
 
             if checkpoint_dir is not None:
-                with spans.span(f"{it}/checkpoint"):
+                with spans.span(f"{it}/checkpoint", name="checkpoint",
+                                iteration=it):
                     ckpt_model = GameModel(dict(models), task_type)
                     if pipelined:
                         if checkpointer is None:
@@ -1277,6 +1304,7 @@ def run_coordinate_descent(
                                           best_model, best_metric,
                                           checkpoint_fingerprint)
 
+            telemetry.pop(_it_span)
             if faults.preemption_requested():
                 # iteration boundary: this iteration's record is submitted
                 # (pipelined) or already on disk (strict) — drain and exit
@@ -1309,6 +1337,14 @@ def run_coordinate_descent(
                            or spec.evaluator.better_than(v, best_metric)):
                 best_metric = v
                 best_model = GameModel(dict(models), task_type)
+
+    # host-blocked accounting into the registry (the PH001 rule's runtime
+    # counterpart): host floats only, no device reads
+    _wall = spans.total()
+    _hb = spans.host_blocked_total()
+    telemetry.gauge("train.host_blocked_s").set(round(_hb, 4))
+    telemetry.gauge("train.host_blocked_frac").set(
+        round(_hb / _wall, 6) if _wall > 0 else 0.0)
 
     final = GameModel(dict(models), task_type)
     if validation_dataset is None or not validation_specs:

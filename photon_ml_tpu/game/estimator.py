@@ -201,7 +201,12 @@ class GameEstimator:
         failed Spark driver restarts the job from scratch, SURVEY §5.3)."""
         if self.emitter is not None:
             self.emitter.send_event(TrainingStartEvent(time.time()))
+        from photon_ml_tpu import telemetry
         from photon_ml_tpu.game.coordinate_descent import PhaseTimings
+        # root span of the whole fit (push/pop: an exception path is healed
+        # by Tracer.finish() at export time)
+        _fit_span = telemetry.push("fit", task=self.config.task_type,
+                                   coordinates=len(self.config.coordinates))
         spans = PhaseTimings()
         # snapshot BEFORE the build: eager mesh staging of FE shards happens
         # inside _build_coordinates and belongs to this fit's cold bytes
@@ -211,7 +216,7 @@ class GameEstimator:
             mesh_snap0 = transfer_snapshot()
         # coordinate construction includes the RE dataset bucketing — a real
         # cost at corpus scale that round 3's phase timings never saw
-        with spans.span("build/coordinates"):
+        with spans.span("build/coordinates", name="build"):
             coords = self._build_coordinates(dataset)
         residency = self._residency_manager(coords, dataset)
         specs = (self._validation_specs(evaluator_specs)
@@ -255,6 +260,7 @@ class GameEstimator:
                 TransferStats, transfer_snapshot)
             mesh_transfer = TransferStats.delta(mesh_snap0,
                                                 transfer_snapshot())
+        telemetry.pop(_fit_span)
         return GameResult(model=descent.best_model, config=self.config,
                           objective_history=descent.objective_history,
                           validation=validation, descent=descent,

@@ -50,6 +50,8 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu import telemetry
+
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.game import (
     FactoredRandomEffectModel, FixedEffectModel, MatrixFactorizationModel,
@@ -175,6 +177,13 @@ class QuarantineMonitor:
         e = {"iteration": int(iteration), "coordinate": coordinate,
              "action": action, **extra}
         self.events.append(e)
+        # containment is observable outside the fit result too: a counter
+        # per action in the registry, and — when the tracer is armed — a
+        # run-log event correlated by span id with the coordinate visit
+        # whose flush discovered the divergence
+        telemetry.counter(f"train.quarantine.{action}").inc()
+        telemetry.event("quarantine", iteration=int(iteration),
+                        coordinate=coordinate, action=action)
         logger.warning("quarantine: iter %d coordinate %-16s %s %s",
                        iteration, coordinate, action, extra or "")
         return e

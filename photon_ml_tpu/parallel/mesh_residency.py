@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.parallel.mesh import (
     DATA_AXIS, data_sharding, feature_sharding, replicated,
 )
@@ -89,18 +90,26 @@ class TransferStats:
             else:
                 self.cold_bytes += nbytes
                 self.cold_stages += 1
+        # registry mirror: telemetry.snapshot() carries the cold/warm split
+        # without reaching into the residency singleton
+        kind = "warm" if warm else "cold"
+        telemetry.counter(f"mesh.{kind}_bytes").inc(nbytes)
+        telemetry.counter(f"mesh.{kind}_stages").inc()
 
     def note_invalidation(self, count: int = 1) -> None:
         with self._lock:
             self.invalidations += count
+        telemetry.counter("mesh.invalidations").inc(count)
 
     def note_eviction(self) -> None:
         with self._lock:
             self.evictions += 1
+        telemetry.counter("mesh.evictions").inc()
 
     def note_retry(self) -> None:
         with self._lock:
             self.retries += 1
+        telemetry.counter("mesh.retries").inc()
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
@@ -213,9 +222,11 @@ class MeshResidency:
             attempt += 1
             try:
                 faults.fire("mesh.stage", key=str(key), field=field)
-                src = (host_or_build() if callable(host_or_build)
-                       else host_or_build)
-                staged, nbytes = _stage_tree(mesh, src, fill, spec)
+                with telemetry.span("mesh_stage", key=str(key), field=field,
+                                    warm=warm):
+                    src = (host_or_build() if callable(host_or_build)
+                           else host_or_build)
+                    staged, nbytes = _stage_tree(mesh, src, fill, spec)
                 self.stats.note_stage(nbytes, warm=warm)
                 return staged, nbytes
             except (KeyboardInterrupt, SystemExit):

@@ -1,132 +1,181 @@
-"""Serving observability: one thread-safe accumulator, JSON out.
+"""Serving observability on the telemetry metrics registry.
 
 Counts requests/rows/batches, shed and deadline failures, entity hit-rate,
-bucket compiles, and model swaps; keeps a bounded ring of request latencies
-for percentile estimates and a running batch-occupancy mean (rows actually
-scored / padded bucket rows — the padding waste of the power-of-two
-bucketing rule, the serving twin of `RandomEffectDataset.padding_stats`).
+bucket compiles, and model swaps; request latencies live in the registry's
+BOUNDED histogram reservoir (the unbounded-percentile-list failure mode is
+structurally impossible), and batch occupancy (rows actually scored /
+padded bucket rows — the padding waste of the power-of-two bucketing rule,
+the serving twin of `RandomEffectDataset.padding_stats`) stays a running
+ratio of counters.
 
-`snapshot()` is the JSON surface: the serve CLI dumps it on SIGUSR1 and on
-a periodic timer, and `bench.py --serve` records it in BENCH_serve.json.
+Two render paths off the same instruments:
+
+  * `snapshot()` — the JSON surface (p50/p90/p95/p99 latency included):
+    the serve CLI dumps it on SIGUSR1 / a periodic timer and at
+    `GET /metrics.json`, and `bench.py --serve` records it in
+    BENCH_serve.json.
+  * `prometheus()` — text exposition 0.0.4 for `GET /metrics` (counters
+    as `photon_serving_*_total`, the latency histogram as a summary with
+    quantile series), scrapeable by a stock Prometheus.
+
+Each ServingMetrics owns a PRIVATE MetricsRegistry, so concurrent services
+in one process never cross their numbers; `telemetry.snapshot()` still
+sees the live service because ScoringService registers its snapshot as a
+telemetry collector.
 """
 from __future__ import annotations
 
-import collections
 import threading
 import time
 from typing import Dict, Optional
 
-import numpy as np
+from photon_ml_tpu.telemetry.export import prometheus_text
+from photon_ml_tpu.telemetry.metrics import MetricsRegistry
 
 
 class ServingMetrics:
-    """All mutation behind one lock; snapshot() copies then computes."""
+    """All instruments behind one registry; compound updates take the
+    local lock so ratios stay coherent."""
 
-    def __init__(self, latency_window: int = 8192):
+    def __init__(self, latency_window: int = 8192,
+                 registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
-        self.requests = 0
-        self.rows = 0
-        self.batches = 0
-        self.batched_rows = 0          # rows through device batches
-        self.bucket_rows = 0           # padded bucket rows those cost
-        self.shed = 0
-        self.deadline_exceeded = 0
-        self.errors = 0
-        self.entity_lookups = 0
-        self.entity_hits = 0
-        self.bucket_compiles = 0
-        self.swaps = 0
-        self.rollbacks = 0
-        self._latencies = collections.deque(maxlen=latency_window)
-        self._queue_wait_sum = 0.0
-        self._score_time_sum = 0.0
-        self._requests_per_batch_sum = 0
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self._requests = r.counter("serving.requests")
+        self._rows = r.counter("serving.rows")
+        self._batches = r.counter("serving.batches")
+        self._batched_rows = r.counter("serving.batched_rows")
+        self._bucket_rows = r.counter("serving.bucket_rows")
+        self._shed = r.counter("serving.shed")
+        self._deadline = r.counter("serving.deadline_exceeded")
+        self._errors = r.counter("serving.errors")
+        self._entity_lookups = r.counter("serving.entity_lookups")
+        self._entity_hits = r.counter("serving.entity_hits")
+        self._bucket_compiles = r.counter("serving.bucket_compiles")
+        self._swaps = r.counter("serving.swaps")
+        self._rollbacks = r.counter("serving.rollbacks")
+        self._requests_per_batch_sum = r.counter(
+            "serving.requests_per_batch_sum")
+        self._queue_wait = r.counter("serving.queue_wait_s")
+        self._score_time = r.counter("serving.batch_score_s")
+        self._latency = r.histogram("serving.latency_s",
+                                    reservoir=latency_window)
+
+    # counter-value conveniences (tests and embedding callers read these
+    # like the old plain-int attributes)
+    @property
+    def requests(self) -> int: return self._requests.value
+
+    @property
+    def rows(self) -> int: return self._rows.value
+
+    @property
+    def batches(self) -> int: return self._batches.value
+
+    @property
+    def shed(self) -> int: return self._shed.value
+
+    @property
+    def deadline_exceeded(self) -> int: return self._deadline.value
+
+    @property
+    def errors(self) -> int: return self._errors.value
+
+    @property
+    def swaps(self) -> int: return self._swaps.value
+
+    @property
+    def rollbacks(self) -> int: return self._rollbacks.value
+
+    @property
+    def bucket_compiles(self) -> int: return self._bucket_compiles.value
 
     # -- recording ---------------------------------------------------------
 
     def observe_request(self, latency_s: float, rows: int) -> None:
-        with self._lock:
-            self.requests += 1
-            self.rows += rows
-            self._latencies.append(latency_s)
+        self._requests.inc()
+        self._rows.inc(rows)
+        self._latency.observe(latency_s)
 
     def observe_batch(self, *, rows: int, bucket_rows: int,
                       num_requests: int, entity_hits: int,
                       entity_lookups: int, new_compiles: int,
                       queue_wait_s: float, score_s: float) -> None:
         with self._lock:
-            self.batches += 1
-            self.batched_rows += rows
-            self.bucket_rows += bucket_rows
-            self._requests_per_batch_sum += num_requests
-            self.entity_hits += entity_hits
-            self.entity_lookups += entity_lookups
-            self.bucket_compiles += new_compiles
-            self._queue_wait_sum += queue_wait_s
-            self._score_time_sum += score_s
+            self._batches.inc()
+            self._batched_rows.inc(rows)
+            self._bucket_rows.inc(bucket_rows)
+            self._requests_per_batch_sum.inc(num_requests)
+            self._entity_hits.inc(entity_hits)
+            self._entity_lookups.inc(entity_lookups)
+            self._bucket_compiles.inc(new_compiles)
+            self._queue_wait.inc(queue_wait_s)
+            self._score_time.inc(score_s)
 
     def observe_shed(self) -> None:
-        with self._lock:
-            self.shed += 1
+        self._shed.inc()
 
     def observe_deadline(self) -> None:
-        with self._lock:
-            self.deadline_exceeded += 1
+        self._deadline.inc()
 
     def observe_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._errors.inc()
 
     def observe_swap(self, rollback: bool = False) -> None:
-        with self._lock:
-            if rollback:
-                self.rollbacks += 1
-            else:
-                self.swaps += 1
+        (self._rollbacks if rollback else self._swaps).inc()
 
     # -- reporting ---------------------------------------------------------
 
     def snapshot(self, model_version: Optional[str] = None) -> Dict:
         with self._lock:
-            lat = np.asarray(self._latencies, np.float64)
+            batches = self._batches.value
+            bucket_rows = self._bucket_rows.value
+            lookups = self._entity_lookups.value
             out = {
                 "uptime_s": round(time.monotonic() - self._t0, 3),
-                "requests": self.requests,
-                "rows": self.rows,
-                "batches": self.batches,
+                "requests": self._requests.value,
+                "rows": self._rows.value,
+                "batches": batches,
                 "requests_per_batch": round(
-                    self._requests_per_batch_sum / self.batches, 3)
-                if self.batches else None,
+                    self._requests_per_batch_sum.value / batches, 3)
+                if batches else None,
                 "batch_occupancy": round(
-                    self.batched_rows / self.bucket_rows, 4)
-                if self.bucket_rows else None,
+                    self._batched_rows.value / bucket_rows, 4)
+                if bucket_rows else None,
                 "entity_hit_rate": round(
-                    self.entity_hits / self.entity_lookups, 4)
-                if self.entity_lookups else None,
-                "bucket_compiles": self.bucket_compiles,
-                "shed": self.shed,
-                "deadline_exceeded": self.deadline_exceeded,
-                "errors": self.errors,
-                "swaps": self.swaps,
-                "rollbacks": self.rollbacks,
+                    self._entity_hits.value / lookups, 4)
+                if lookups else None,
+                "bucket_compiles": self._bucket_compiles.value,
+                "shed": self._shed.value,
+                "deadline_exceeded": self._deadline.value,
+                "errors": self._errors.value,
+                "swaps": self._swaps.value,
+                "rollbacks": self._rollbacks.value,
                 "mean_queue_wait_ms": round(
-                    1e3 * self._queue_wait_sum / self.batches, 3)
-                if self.batches else None,
+                    1e3 * self._queue_wait.value / batches, 3)
+                if batches else None,
                 "mean_batch_score_ms": round(
-                    1e3 * self._score_time_sum / self.batches, 3)
-                if self.batches else None,
+                    1e3 * self._score_time.value / batches, 3)
+                if batches else None,
             }
-        if lat.size:
+        h = self._latency.snapshot()
+        if h["count"]:
             out["latency_ms"] = {
-                "p50": round(1e3 * float(np.percentile(lat, 50)), 3),
-                "p90": round(1e3 * float(np.percentile(lat, 90)), 3),
-                "p99": round(1e3 * float(np.percentile(lat, 99)), 3),
-                "max": round(1e3 * float(lat.max()), 3),
-                "window": int(lat.size),
+                key: round(1e3 * h[src], 3)
+                for key, src in (("p50", "p50"), ("p90", "p90"),
+                                 ("p95", "p95"), ("p99", "p99"),
+                                 ("max", "max"))
             }
+            out["latency_ms"]["window"] = h["window"]
         else:
             out["latency_ms"] = None
         if model_version is not None:
             out["model_version"] = model_version
         return out
+
+    def prometheus(self, model_version: Optional[str] = None) -> str:
+        """Prometheus text exposition of every serving instrument."""
+        info = {"model_version": model_version} if model_version else None
+        return prometheus_text(self.registry, extra_info=info)

@@ -22,12 +22,14 @@ inverse link (`mean_prediction` applies the link when callers want means).
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry.timings import clock
 
 from photon_ml_tpu.models.game import (
     FactoredRandomEffectModel, FixedEffectModel, GameModel,
@@ -164,13 +166,14 @@ class CompiledScorer:
 
     def warmup(self) -> float:
         """Compile every bucket program now, so no request ever does."""
-        t0 = time.perf_counter()
-        for b in self.bucket_sizes():
-            xs = {s: np.zeros((b, d), np.float64)
-                  for s, d in self.feature_shards.items()}
-            lanes = {k: np.full(b, -1, np.int32) for k in self._lookups}
-            jax.block_until_ready(self._run_bucket(xs, lanes, b))
-        self.warmup_s = time.perf_counter() - t0
+        t0 = clock()
+        with telemetry.span("serve_warmup", version=self.version):
+            for b in self.bucket_sizes():
+                xs = {s: np.zeros((b, d), np.float64)
+                      for s, d in self.feature_shards.items()}
+                lanes = {k: np.full(b, -1, np.int32) for k in self._lookups}
+                jax.block_until_ready(self._run_bucket(xs, lanes, b))
+        self.warmup_s = clock() - t0
         self.warmed = True
         return self.warmup_s
 

@@ -20,6 +20,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.serving.batcher import (BatcherConfig, MicroBatcher,
                                            ServingError)
 from photon_ml_tpu.serving.metrics import ServingMetrics
@@ -72,6 +73,10 @@ class ScoringService:
             on_shed=self.metrics.observe_shed,
             on_deadline=self.metrics.observe_deadline)
         self._closed = False
+        # one telemetry.snapshot() returns serving state alongside the
+        # training/streaming registries (latest-constructed service wins
+        # the name; close() unregisters)
+        telemetry.register_collector("serving", self.metrics_snapshot)
 
     # -- scoring -----------------------------------------------------------
 
@@ -108,7 +113,11 @@ class ScoringService:
                      queue_wait_s: float):
         scorer = self.registry.scorer  # resolved per batch: swap boundary
         t0 = time.monotonic()
-        result = scorer.score(features, ids)
+        # span on the micro-batcher worker thread: serving gets its own
+        # track in the trace, one span per coalesced device batch
+        with telemetry.span("serve_batch", requests=num_requests,
+                            version=scorer.version):
+            result = scorer.score(features, ids)
         score_s = time.monotonic() - t0
         self.metrics.observe_batch(
             rows=result.num_rows, bucket_rows=sum(result.buckets),
@@ -146,9 +155,14 @@ class ScoringService:
     def metrics_snapshot(self) -> Dict:
         return self.metrics.snapshot(model_version=self.registry.version)
 
+    def prometheus_metrics(self) -> str:
+        """Prometheus text exposition (the serving /metrics endpoint)."""
+        return self.metrics.prometheus(model_version=self.registry.version)
+
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            telemetry.unregister_collector("serving")
             self._batcher.close()
 
     def __enter__(self):

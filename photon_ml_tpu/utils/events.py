@@ -2,9 +2,16 @@
 
 Rebuild of photon-client/.../event/{Event,EventEmitter,EventListener}.scala:
 typed events (setup, training start/finish, per-model optimization log —
-Event.scala:36-60) fanned out to registered listeners; listener exceptions
-are swallowed so a broken consumer can't kill training (EventEmitter
-sendEvent wraps each handle in Try).
+Event.scala:36-60) fanned out to registered listeners.  Listener exceptions
+are ISOLATED per listener (log + continue, EventEmitter sendEvent wraps
+each handle in Try): one broken consumer can neither kill training nor
+starve the listeners registered after it — tests/test_telemetry.py pins
+this down.
+
+Every emitted event is also routed into the telemetry run log (when the
+tracer is armed) tagged with the ACTIVE SPAN ID, so an
+OptimizationLogEvent or ScoringBatchEvent lands in the same timeline as
+the spans and fault/quarantine records it belongs to.
 
 Listeners can be registered programmatically or by dotted class path (the
 reference registers listener class names from CLI flags, Driver.scala:
@@ -143,10 +150,36 @@ class EventEmitter:
             self._listeners = []
 
     def send_event(self, event: Event) -> None:
+        _route_to_telemetry(event)
         with self._lock:
             listeners = list(self._listeners)
         for listener in listeners:
+            # isolation contract: a listener that raises is logged and the
+            # REMAINING listeners still receive the event (only exiting-
+            # process exceptions propagate)
             try:
                 listener.handle(event)
             except Exception:
                 _log.exception("event listener failed on %s", type(event).__name__)
+
+
+def _route_to_telemetry(event: Event) -> None:
+    """Emitted event -> telemetry run-log record with the active span id
+    (no-op when the tracer is disarmed).  Field values are flattened to
+    JSON-safe scalars; containers collapse to their sizes (an
+    OptimizationLogEvent's whole objective history belongs in the result
+    object, not in every run-log line)."""
+    from photon_ml_tpu import telemetry
+    tracer = telemetry.active_tracer()
+    if tracer is None:
+        return
+    attrs = {}
+    for f in dataclasses.fields(event):
+        v = getattr(event, f.name)
+        if isinstance(v, (bool, int, float, str)) or v is None:
+            attrs[f.name] = v
+        elif isinstance(v, (list, tuple, dict)):
+            attrs[f.name + "_len"] = len(v)
+        else:
+            attrs[f.name] = str(v)
+    tracer.event("emitted." + type(event).__name__, attrs)

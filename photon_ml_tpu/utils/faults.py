@@ -251,6 +251,15 @@ class FaultPlan:
             return None
         logger.warning("fault injection: site=%s ctx=%s action=%s",
                        site, ctx, action)
+        # telemetry correlation: the fired fault lands in the run log /
+        # trace attached to whatever span is active at the injection site
+        # (a chunk-staging span, a coordinate visit, a checkpoint write).
+        # Import here, not at module top: faults must stay importable with
+        # zero package dependencies for subprocess children arming early.
+        from photon_ml_tpu import telemetry
+        telemetry.counter("faults.fired").inc()
+        telemetry.event("fault", site=site, action=action,
+                        **{k: str(v) for k, v in ctx.items()})
         if action == "transient":
             raise TransientFault(f"injected transient fault at {site!r} "
                                  f"(ctx {ctx})")

@@ -1,0 +1,25 @@
+"""PH007 near-miss: telemetry-routed timing and non-span time calls are
+fine in hot modules."""
+import time
+
+from photon_ml_tpu.telemetry.timings import PhaseTimings, clock
+
+
+def timed_solve(run, spans: PhaseTimings):
+    with spans.span("solve"):          # the sanctioned span path
+        run()
+    t0 = clock()                       # the sanctioned raw timestamp
+    run()
+    return clock() - t0
+
+
+def wall_stamp():
+    return time.time()                 # wall-clock stamps are not spans
+
+
+def backoff(delay):
+    time.sleep(delay)                  # not a timer at all
+
+
+def queue_deadline(timeout):
+    return time.monotonic() + timeout  # deadlines/uptime, not span timing

@@ -1,0 +1,15 @@
+"""PH007 fixture: raw perf_counter span timing in a hot-path module."""
+import time
+from time import perf_counter
+
+
+def timed_solve(run):
+    t0 = time.perf_counter()          # PH007: raw span timing
+    run()
+    return time.perf_counter() - t0   # PH007
+
+
+def timed_stage_ns(stage):
+    t0 = perf_counter()               # PH007: from-import form
+    stage()
+    return time.perf_counter_ns() - int(t0 * 1e9)  # PH007

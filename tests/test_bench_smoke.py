@@ -198,6 +198,51 @@ def test_mesh_smoke(tmp_path):
     assert stream["per_device_accounting"]["data_devices"] >= 8
 
 
+def test_trace_smoke(tmp_path):
+    """bench.py --trace --smoke end-to-end in tier-1 (ISSUE 8 satellite):
+    the telemetry harness — disarmed zero-overhead contract, zero fresh
+    XLA traces on a warm fit armed or disarmed, cli.train --trace-out
+    emitting valid Chrome-trace JSON with a correctly nested span tree and
+    fault/quarantine events attached to the right spans — cannot rot
+    without failing the normal test run."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_trace.json"
+    result = bench.trace_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    # every bench mode embeds the telemetry snapshot (ISSUE 8 satellite)
+    assert "metrics" in detail["telemetry"]
+
+    overhead = next(e for e in detail["entries"]
+                    if e["name"] == "disarmed_overhead")
+    # disarmed AND armed warm fits: zero fresh XLA traces
+    assert overhead["fresh_traces_disarmed_warm"] == 0
+    assert overhead["fresh_traces_armed_warm"] == 0
+    # the 1%-of-wall-clock gate on the disarmed instrumentation
+    assert overhead["overhead_frac_estimate"] <= overhead["overhead_gate"]
+    assert overhead["span_calls_per_fit"] > 0
+
+    cli = next(e for e in detail["entries"] if e["name"] == "cli_trace")
+    assert cli["returncode"] == 0
+    # the emitted trace validates against the Chrome trace format's
+    # required keys (name/ph/ts/pid/tid, dur on complete events)
+    assert cli["trace_valid"] is True and cli["trace_problems"] == []
+    # span tree: outer iterations -> coordinate visits -> solves
+    assert cli["nesting_ok"] is True
+    assert cli["solves_nest_in_visits"] is True
+    # the injected solve.poison landed on the perUser visit's spans and
+    # its quarantine containment recovered
+    assert cli["fault_attributed_coordinates"] == ["perUser"]
+    assert cli["quarantine_recovered"] is True
+    assert cli["run_log_records"] > 0
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a

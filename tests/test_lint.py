@@ -32,6 +32,8 @@ CASES = [
     ("PH004", "ph004_violation.py", "ph004_compliant.py", 3),
     ("PH005", "durable/models/io.py", "durable_ok/models/io.py", 2),
     ("PH006", "ph006_violation.py", "ph006_compliant.py", 2),
+    ("PH007", "hot/ops/ph007_violation.py",
+     "hot/ops/ph007_compliant.py", 4),
 ]
 
 
@@ -190,7 +192,8 @@ def test_cli_json_output_and_exit_codes():
 def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule_id in ("PH001", "PH002", "PH003", "PH004", "PH005", "PH006"):
+    for rule_id in ("PH001", "PH002", "PH003", "PH004", "PH005", "PH006",
+                    "PH007"):
         assert rule_id in out
 
 

@@ -520,9 +520,18 @@ def test_cli_serve_http_roundtrip(served_model):
                 "ids": {"userId": ["u1", "ghost"]}}
         out = post("/score", body)
         assert len(out["scores"]) == 2
-        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+        with urllib.request.urlopen(base + "/metrics.json",
+                                    timeout=30) as resp:
             metrics = json.loads(resp.read())
         assert metrics["requests"] == 1 and metrics["rows"] == 2
+        assert metrics["latency_ms"]["p95"] >= 0
+        # /metrics is the Prometheus scrape endpoint (text 0.0.4)
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            prom = resp.read().decode()
+        assert "photon_serving_requests_total 1" in prom
+        assert 'photon_serving_latency_s{quantile="0.99"}' in prom
+        assert "# TYPE photon_serving_latency_s summary" in prom
         # scores match an in-process scorer on the same model
         rng = np.random.default_rng(3)
         model = _make_model(rng)

@@ -1,0 +1,462 @@
+"""Unified telemetry (ISSUE 8): span tracer disarm semantics, thread-aware
+hierarchy, metrics registry, exporters, run-log correlation, EventEmitter
+routing/isolation, and the compile-count + sync-point regression gates
+that keep the instrumentation off the device hot path.
+"""
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry.export import prometheus_text
+from photon_ml_tpu.telemetry.metrics import MetricsRegistry
+from photon_ml_tpu.utils.events import (
+    EventEmitter, EventListener, ScoringBatchEvent, TrainingStartEvent,
+)
+
+
+# --------------------------------------------------------------------------
+# disarm semantics
+# --------------------------------------------------------------------------
+
+def test_disarmed_span_is_the_shared_noop_singleton():
+    """faults.fire()-style disarm: a module-global None check returning
+    ONE shared object — no span allocation, no record, no tracer."""
+    assert not telemetry.armed()
+    a = telemetry.span("anything", attr=1)
+    b = telemetry.span("other")
+    assert a is b is telemetry.NOOP_SPAN
+    with a:
+        assert telemetry.current_span_id() is None
+    assert telemetry.push("x") is None
+    telemetry.pop(None)                    # no-op, no error
+    telemetry.event("nothing", k=2)        # no-op
+
+
+def test_enabled_scope_arms_and_disarms():
+    assert not telemetry.armed()
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        assert telemetry.armed()
+        assert telemetry.active_tracer() is tracer
+    assert not telemetry.armed()
+    assert telemetry.last_tracer() is tracer  # still exportable
+
+
+# --------------------------------------------------------------------------
+# span hierarchy
+# --------------------------------------------------------------------------
+
+def test_span_nesting_parents_and_attrs():
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        with telemetry.span("outer", iteration=3) as outer:
+            assert telemetry.current_span_id() == outer.span_id
+            with telemetry.span("inner", coordinate="perUser") as inner:
+                pass
+        assert telemetry.current_span_id() is None
+    assert inner.parent_id == outer.span_id
+    assert outer.parent_id is None
+    assert inner.attrs == {"coordinate": "perUser"}
+    assert all(s.dur_s is not None and s.dur_s >= 0 for s in tracer.spans)
+
+
+def test_push_pop_self_heals_abandoned_spans():
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        a = telemetry.push("a")
+        telemetry.push("b")  # never popped explicitly
+        telemetry.pop(a)     # closes b, then a
+        assert telemetry.current_span_id() is None
+    names = [s.name for s in tracer.spans]
+    assert names == ["b", "a"]
+
+
+def test_finish_closes_spans_left_open_by_an_exception():
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        telemetry.push("leaked")
+    # enabled.__exit__ -> shutdown -> finish heals the stack
+    assert [s.name for s in tracer.spans] == ["leaked"]
+    assert tracer.stats()["open_spans"] == 0
+
+
+def test_threads_get_their_own_span_roots():
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        with telemetry.span("main_root"):
+            def work():
+                with telemetry.span("bg_root"):
+                    pass
+            t = threading.Thread(target=work, name="photon-test-bg")
+            t.start()
+            t.join()
+    bg = next(s for s in tracer.spans if s.name == "bg_root")
+    main = next(s for s in tracer.spans if s.name == "main_root")
+    assert bg.parent_id is None          # thread root, not nested in main
+    assert bg.tid != main.tid
+    assert bg.thread_name == "photon-test-bg"
+
+
+def test_event_attaches_to_current_span():
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        with telemetry.span("visit") as visit:
+            telemetry.event("fault", site="solve.poison")
+        telemetry.event("orphan")
+    assert tracer.events[0]["span"] == visit.span_id
+    assert tracer.events[1]["span"] is None
+
+
+# --------------------------------------------------------------------------
+# metrics registry
+# --------------------------------------------------------------------------
+
+def test_counters_gauges_and_type_collision():
+    r = MetricsRegistry()
+    r.counter("c").inc()
+    r.counter("c").inc(4)
+    assert r.counter("c").value == 5
+    with pytest.raises(ValueError):
+        r.counter("c").inc(-1)
+    r.gauge("g").set(2.5)
+    assert r.gauge("g").value == 2.5
+    with pytest.raises(TypeError):
+        r.gauge("c")  # name already a counter
+
+
+def test_histogram_reservoir_is_bounded_and_exact_counts():
+    r = MetricsRegistry()
+    h = r.histogram("lat", reservoir=64)
+    for i in range(10_000):
+        h.observe(i)
+    snap = h.snapshot()
+    assert snap["count"] == 10_000          # exact
+    assert snap["max"] == 9_999.0           # exact
+    assert snap["window"] == 64             # bounded
+    # the reservoir is a newest-N window, so percentiles track the tail
+    assert snap["p50"] >= 9_900
+    assert snap["p99"] >= snap["p95"] >= snap["p50"]
+    assert json.dumps(r.snapshot())         # JSON-safe
+
+
+def test_snapshot_includes_collectors():
+    telemetry.register_collector("test_collector", lambda: {"x": 1})
+    try:
+        snap = telemetry.snapshot()
+        assert snap["test_collector"] == {"x": 1}
+        assert "metrics" in snap
+        json.dumps(snap)
+    finally:
+        telemetry.unregister_collector("test_collector")
+    assert "test_collector" not in telemetry.snapshot()
+
+
+def test_prometheus_text_exposition():
+    r = MetricsRegistry()
+    r.counter("serving.requests").inc(7)
+    r.gauge("train.host_blocked_frac").set(0.25)
+    h = r.histogram("serving.latency_s", reservoir=16)
+    h.observe(0.01)
+    h.observe(0.02)
+    text = prometheus_text(r, extra_info={"model_version": "v3"})
+    assert "# TYPE photon_serving_requests_total counter" in text
+    assert "photon_serving_requests_total 7" in text
+    assert "photon_train_host_blocked_frac 0.25" in text
+    assert "# TYPE photon_serving_latency_s summary" in text
+    assert 'photon_serving_latency_s{quantile="0.99"}' in text
+    assert "photon_serving_latency_s_count 2" in text
+    assert 'photon_info{model_version="v3"} 1' in text
+    assert text.endswith("\n")
+
+
+# --------------------------------------------------------------------------
+# exporters + run log
+# --------------------------------------------------------------------------
+
+def test_chrome_trace_export_required_keys_and_tree(tmp_path):
+    with telemetry.enabled(watch_compiles=False):
+        with telemetry.span("outer_iteration", iteration=0):
+            with telemetry.span("coordinate_visit", coordinate="fixed"):
+                telemetry.event("fault", site="stage.fetch")
+    out = tmp_path / "trace.json"
+    info = telemetry.write_chrome_trace(str(out))
+    assert info["events"] >= 3
+    payload = json.loads(out.read_text())
+    assert telemetry.validate_chrome_trace(payload) == []
+    events = payload["traceEvents"]
+    spans = {e["args"]["span"]: e for e in events if e["ph"] == "X"}
+    visit = next(e for e in events if e["name"] == "coordinate_visit")
+    assert spans[visit["args"]["parent"]]["name"] == "outer_iteration"
+    instant = next(e for e in events if e["name"] == "fault")
+    assert instant["ph"] == "i"
+    assert instant["args"]["span"] == visit["args"]["span"]
+
+
+def test_validate_chrome_trace_flags_missing_keys():
+    bad = {"traceEvents": [{"name": "x", "ph": "X", "ts": 0, "pid": 1}]}
+    problems = telemetry.validate_chrome_trace(bad)
+    assert any("tid" in p for p in problems)
+    assert any("dur" in p for p in problems)
+    assert telemetry.validate_chrome_trace({"traceEvents": []})
+
+
+def test_run_log_correlates_spans_and_events(tmp_path):
+    log_path = tmp_path / "run.jsonl"
+    with telemetry.enabled(run_log=str(log_path), watch_compiles=False):
+        with telemetry.span("coordinate_visit", coordinate="perUser"):
+            telemetry.event("quarantine", action="rolled_back")
+    records = [json.loads(line) for line in log_path.read_text().splitlines()]
+    ev = next(r for r in records if r["kind"] == "event")
+    span = next(r for r in records if r["kind"] == "span")
+    assert ev["span"] == span["span"]
+    assert span["attrs"]["coordinate"] == "perUser"
+    assert ev["attrs"]["action"] == "rolled_back"
+
+
+# --------------------------------------------------------------------------
+# EventEmitter routing + listener isolation (ISSUE 8 satellite)
+# --------------------------------------------------------------------------
+
+class _Boom(EventListener):
+    def handle(self, event):
+        raise RuntimeError("listener exploded")
+
+
+class _Sink(EventListener):
+    def __init__(self):
+        self.got = []
+
+    def handle(self, event):
+        self.got.append(event)
+
+
+def test_listener_exception_is_isolated_from_remaining_listeners(caplog):
+    emitter = EventEmitter()
+    first, last = _Sink(), _Sink()
+    emitter.register_listener(first)
+    emitter.register_listener(_Boom())
+    emitter.register_listener(last)
+    with caplog.at_level(logging.ERROR, "photon_ml_tpu.utils.events"):
+        emitter.send_event(TrainingStartEvent(time=1.0))
+    # the raising listener neither killed emission nor starved the
+    # listeners registered AFTER it
+    assert len(first.got) == 1 and len(last.got) == 1
+    assert any("event listener failed" in r.message for r in caplog.records)
+
+
+def test_emitted_events_route_into_run_log_with_span_id(tmp_path):
+    log_path = tmp_path / "run.jsonl"
+    emitter = EventEmitter()
+    emitter.register_listener(_Sink())
+    with telemetry.enabled(run_log=str(log_path), watch_compiles=False):
+        with telemetry.span("serve_batch") as batch_span:
+            emitter.send_event(ScoringBatchEvent(
+                time=1.0, num_requests=3, num_rows=7, bucket_size=8,
+                queue_wait_s=0.001, score_s=0.002, model_version="v1"))
+    records = [json.loads(line) for line in log_path.read_text().splitlines()]
+    ev = next(r for r in records
+              if r["name"] == "emitted.ScoringBatchEvent")
+    assert ev["span"] == batch_span.span_id
+    assert ev["attrs"]["num_rows"] == 7
+    assert ev["attrs"]["model_version"] == "v1"
+
+
+def test_emitter_without_tracer_stays_silent():
+    emitter = EventEmitter()
+    sink = _Sink()
+    emitter.register_listener(sink)
+    emitter.send_event(TrainingStartEvent(time=2.0))  # disarmed: no crash
+    assert len(sink.got) == 1
+
+
+# --------------------------------------------------------------------------
+# hot-path regression gates
+# --------------------------------------------------------------------------
+
+def _tiny_game(rng):
+    from photon_ml_tpu.data.game_data import build_game_dataset
+    n, E = 400, 20
+    xg = rng.normal(size=(n, 5))
+    xu = rng.normal(size=(n, 3))
+    users = np.asarray([f"u{i % E}" for i in range(n)], dtype=object)
+    y = (rng.uniform(size=n) < 0.5).astype(float)
+    return build_game_dataset(y, {"global": xg, "per_user": xu},
+                              entity_ids={"userId": users})
+
+
+def _tiny_config(outer=2):
+    from photon_ml_tpu.game import (FixedEffectCoordinateConfig,
+                                    GameTrainingConfig,
+                                    GLMOptimizationConfig,
+                                    RandomEffectCoordinateConfig)
+    from photon_ml_tpu.optim import (OptimizerConfig, RegularizationContext,
+                                     RegularizationType)
+    l2 = RegularizationContext(RegularizationType.L2)
+    opt = GLMOptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=4),
+        regularization=l2, regularization_weight=1.0)
+    return GameTrainingConfig(
+        task_type="logistic_regression",
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig("global", opt),
+            "perUser": RandomEffectCoordinateConfig(
+                "userId", "per_user", opt, projector="identity")},
+        updating_sequence=["fixed", "perUser"],
+        num_outer_iterations=outer)
+
+
+class _CompileCounter(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def emit(self, record):
+        if record.getMessage().startswith("Compiling "):
+            self.count += 1
+
+
+class _compile_counting:
+    def __enter__(self):
+        import jax
+        self._jax = jax
+        self.handler = _CompileCounter()
+        self.logger = logging.getLogger("jax._src.interpreters.pxla")
+        self._level = self.logger.level
+        self.logger.addHandler(self.handler)
+        self.logger.setLevel(logging.WARNING)
+        jax.config.update("jax_log_compiles", True)
+        return self.handler
+
+    def __exit__(self, *exc):
+        self._jax.config.update("jax_log_compiles", False)
+        self.logger.removeHandler(self.handler)
+        self.logger.setLevel(self._level)
+
+
+def test_armed_telemetry_adds_zero_fresh_traces_to_a_warm_fit(rng):
+    """The compile-count regression the tentpole promises: once a fit's
+    shapes are warm, running the SAME fit with the tracer armed must not
+    introduce a single fresh XLA trace (span names/attrs never reach a
+    jit boundary), and disarmed instrumentation obviously must not
+    either."""
+    from photon_ml_tpu.game import GameEstimator
+    ds = _tiny_game(rng)
+    GameEstimator(_tiny_config()).fit(ds)  # warm every program
+
+    with _compile_counting() as counter:
+        GameEstimator(_tiny_config()).fit(ds)
+    assert counter.count == 0, (
+        f"{counter.count} fresh traces on a warm DISARMED fit")
+
+    with _compile_counting() as counter:
+        with telemetry.enabled(watch_compiles=False) as tracer:
+            result = GameEstimator(_tiny_config()).fit(ds)
+    assert counter.count == 0, (
+        f"{counter.count} fresh traces on a warm ARMED fit — telemetry "
+        "leaked into a trace cache key or forced a retrace")
+    # the armed fit actually traced spans (it wasn't a silent no-op)
+    names = {s.name for s in tracer.spans}
+    assert {"fit", "outer_iteration", "coordinate_visit", "solve"} <= names
+    # and the per-coordinate retrace surface reports zero everywhere
+    for diag in result.descent.solver_diagnostics().values():
+        assert diag["retraces"] == 0
+        assert "host_blocked_s" in diag
+
+
+def test_retrace_counter_counts_fresh_compiles_with_signature():
+    """The PH002 runtime counterpart: a genuinely fresh compile under an
+    armed compile watch increments jax.retraces and records a compile
+    event carrying the triggering signature."""
+    import jax
+    import jax.numpy as jnp
+    before = telemetry.retrace_count()
+    with telemetry.enabled() as tracer:  # watch_compiles=True default
+        with telemetry.span("coordinate_visit", coordinate="fresh"):
+            # a shape this process has never traced (odd prime size)
+            f = jax.jit(lambda x: (x * 1.000173).sum())
+            float(f(jnp.zeros(1913)))
+    assert telemetry.retrace_count() > before
+    compiles = [e for e in tracer.events if e["name"] == "compile"]
+    assert compiles, "no compile events recorded by the watch"
+    assert any("1913" in e["attrs"].get("signature", "")
+               for e in compiles)
+    # attribution: the compile event is attached to the span that
+    # triggered the trace
+    visit = next(s for s in tracer.spans
+                 if s.name == "coordinate_visit")
+    assert any(e["span"] == visit.span_id for e in compiles)
+    assert not jax.config.jax_log_compiles  # restored on disarm
+
+
+def test_phase_timings_bridges_to_telemetry_spans():
+    from photon_ml_tpu.telemetry.timings import PhaseTimings
+    spans = PhaseTimings()
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        with spans.span("0/fixed/solve", name="solve", coordinate="fixed",
+                        iteration=0):
+            pass
+        with spans.blocked("0/fixed/solve"):
+            pass
+    assert "0/fixed/solve" in spans               # dict accounting intact
+    assert spans.host_blocked["0/fixed/solve"] >= 0
+    solve = next(s for s in tracer.spans if s.name == "solve")
+    assert solve.attrs == {"coordinate": "fixed", "iteration": 0}
+    # disarmed: the dict side keeps working with zero tracer records
+    with spans.span("1/fixed/solve"):
+        pass
+    assert "1/fixed/solve" in spans
+
+
+def test_fired_fault_lands_in_trace_with_site(tmp_path):
+    from photon_ml_tpu.utils import faults
+    plan = faults.FaultPlan([{"site": "stage.fetch", "action": "transient",
+                              "hits": [1]}])
+    before = telemetry.counter("faults.fired").value
+    with telemetry.enabled(watch_compiles=False) as tracer:
+        with telemetry.span("stage", chunk=0):
+            with faults.injected(plan):
+                with pytest.raises(faults.TransientFault):
+                    faults.fire("stage.fetch", chunk=0)
+    fault = next(e for e in tracer.events if e["name"] == "fault")
+    assert fault["attrs"]["site"] == "stage.fetch"
+    assert fault["attrs"]["action"] == "transient"
+    stage = next(s for s in tracer.spans if s.name == "stage")
+    assert fault["span"] == stage.span_id
+    assert telemetry.counter("faults.fired").value == before + 1
+
+
+def test_instrumented_hot_modules_stay_ph001_clean():
+    """Armed tracing must stay off the device hot path: photonlint PH001
+    (host-sync rule) over exactly the modules this PR instrumented."""
+    import photon_ml_tpu
+    from photon_ml_tpu.analysis.engine import lint_paths
+    import os
+    pkg = os.path.dirname(os.path.abspath(photon_ml_tpu.__file__))
+    instrumented = [
+        os.path.join(pkg, "game", "coordinate_descent.py"),
+        os.path.join(pkg, "game", "quarantine.py"),
+        os.path.join(pkg, "parallel", "mesh_residency.py"),
+        os.path.join(pkg, "serving", "service.py"),
+        os.path.join(pkg, "serving", "metrics.py"),
+        os.path.join(pkg, "serving", "scorer.py"),
+    ]
+    findings = lint_paths(instrumented, select=["PH001", "PH007"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_serving_metrics_latency_reservoir_is_bounded():
+    """ISSUE 8 satellite: ServingMetrics percentiles come from the
+    registry's bounded reservoir — 100k observations cost a fixed window,
+    and p50/p95/p99 all surface in snapshot()."""
+    from photon_ml_tpu.serving.metrics import ServingMetrics
+    m = ServingMetrics(latency_window=128)
+    for i in range(100_000):
+        m.observe_request(latency_s=0.001 + (i % 10) * 1e-4, rows=1)
+    snap = m.snapshot(model_version="vX")
+    assert snap["requests"] == 100_000
+    assert snap["latency_ms"]["window"] == 128
+    for key in ("p50", "p90", "p95", "p99", "max"):
+        assert snap["latency_ms"][key] >= 0
+    assert snap["latency_ms"]["p99"] >= snap["latency_ms"]["p50"]
+    assert snap["model_version"] == "vX"
+    prom = m.prometheus(model_version="vX")
+    assert "photon_serving_requests_total 100000" in prom
+    assert 'photon_serving_latency_s{quantile="0.95"}' in prom
