@@ -2759,6 +2759,453 @@ def serve_bench(out_path="BENCH_serve.json"):
 
 
 # --------------------------------------------------------------------------
+# online learning benchmark (--online): per-entity delta swaps into the
+# live scorer
+# --------------------------------------------------------------------------
+
+def _online_model(rng, d_g, d_u, E, scale=1.0):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.game import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+    from photon_ml_tpu.models.glm import model_for_task
+    fe = FixedEffectModel(
+        model_for_task("logistic_regression", Coefficients(
+            jnp.asarray(scale * rng.normal(size=d_g)))), "global")
+    re = RandomEffectModel(
+        random_effect_type="userId", feature_shard="per_user",
+        task_type="logistic_regression",
+        coefficients=jnp.asarray(scale * rng.normal(size=(E, d_u))),
+        entity_ids=np.asarray([f"u{i}" for i in range(E)], dtype=object),
+        projection=None, global_dim=d_u)
+    return GameModel({"fixed": fe, "perUser": re}, "logistic_regression")
+
+
+def _feedback_batch(rng, d_g, d_u, entities, rows):
+    feats = {"global": rng.normal(size=(rows, d_g)),
+             "per_user": rng.normal(size=(rows, d_u))}
+    ids = {"userId": np.asarray(
+        [entities[rng.integers(0, len(entities))] for _ in range(rows)],
+        dtype=object)}
+    labels = (rng.uniform(size=rows) < 0.5).astype(float)
+    return feats, ids, labels
+
+
+def _online_parity_entry(smoke: bool) -> dict:
+    """Gate 1: online-updated entity coefficients match an OFFLINE refit of
+    the same entities (training-side block build, f64) at <= 1e-6 rel, plus
+    an independent scipy L-BFGS-B oracle spot-check of the anchored
+    objective."""
+    from photon_ml_tpu.data.game_data import build_game_dataset
+    from photon_ml_tpu.game.anchored import (anchored_objective_np,
+                                             offline_anchored_refit)
+    from photon_ml_tpu.online import OnlineUpdateConfig
+    from photon_ml_tpu.ops import losses as PL
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+    from scipy.optimize import minimize
+
+    rng = np.random.default_rng(41)
+    d_g, d_u = 16, 8
+    E = 500 if smoke else 5000
+    touched = [f"u{i}" for i in rng.choice(E, size=24, replace=False)]
+    anchor = 0.7
+    model = _online_model(rng, d_g, d_u, E)
+    svc = ScoringService(
+        model=model, config=ServingConfig(max_batch=256, min_bucket=4),
+        updates=OnlineUpdateConfig(micro_batch=8, anchor_weight=anchor,
+                                   max_iterations=200, tolerance=1e-12),
+        start_updater=False)
+    try:
+        scorer = svc.registry.scorer
+        n = 24 * (4 if smoke else 8)
+        feats = {"global": rng.normal(size=(n, d_g)),
+                 "per_user": rng.normal(size=(n, d_u))}
+        ids = {"userId": np.asarray([touched[i % len(touched)]
+                                     for i in range(n)], dtype=object)}
+        labels = (rng.uniform(size=n) < 0.5).astype(float)
+        table = np.asarray(scorer.re_table("perUser"))
+        prior = {u: table[scorer.entity_row("perUser", u)].copy()
+                 for u in touched}
+        margins = scorer.score(feats, ids).scores  # pre-update residuals
+        svc.feedback(feats, ids, labels)
+        flush = svc.updater.flush()
+        table_new = np.asarray(scorer.re_table("perUser"))
+        online = {u: table_new[scorer.entity_row("perUser", u)]
+                  for u in touched}
+
+        ds = build_game_dataset(
+            labels, {"global": feats["global"], "per_user": feats["per_user"]},
+            offsets=margins, entity_ids={"userId": ids["userId"]})
+        offline = offline_anchored_refit(
+            ds, "userId", "per_user", prior,
+            PL.TASK_LOSSES["logistic_regression"],
+            OptimizerConfig(max_iterations=200, tolerance=1e-12),
+            anchor_weight=anchor)
+        rels = []
+        for u in touched:
+            denom = max(float(np.max(np.abs(offline[u]))), 1e-12)
+            rels.append(float(np.max(np.abs(online[u] - offline[u])) / denom))
+        worst = max(rels)
+
+        # independent oracle: scipy minimizes the anchored objective on the
+        # raw feedback rows of 3 entities (no shared solver code at all)
+        scipy_rels = []
+        for u in touched[:3]:
+            rows = [i for i in range(n) if ids["userId"][i] == u]
+            f = lambda c: anchored_objective_np(
+                feats["per_user"][rows], labels[rows], None, margins[rows],
+                c, prior[u], "logistic_regression", anchor)
+            res = minimize(f, prior[u], method="L-BFGS-B", tol=1e-14)
+            denom = max(float(np.max(np.abs(res.x))), 1e-12)
+            scipy_rels.append(
+                float(np.max(np.abs(online[u] - res.x)) / denom))
+        gate = 1e-6
+        return {
+            "name": "online_parity", "entities": len(touched),
+            "feedback_rows": n, "deltas": flush["deltas"],
+            "max_rel_gap_vs_offline_refit": worst,
+            "scipy_oracle_rel_gaps": [round(r, 9) for r in scipy_rels],
+            "parity_gate": gate,
+            "parity_ok": bool(worst <= gate
+                              and max(scipy_rels) <= 1e-4),
+        }
+    finally:
+        svc.close()
+
+
+def _online_latency_entry(smoke: bool) -> dict:
+    """Gate 2: scoring p99 while a concurrent feedback stream drives
+    sustained delta publishes stays <= 1.5x the no-update baseline; also
+    the sustained update throughput (entities/sec) this run achieved."""
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from photon_ml_tpu.online import OnlineUpdateConfig
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+
+    rng = np.random.default_rng(43)
+    d_g, d_u = 16, 8
+    E = 1000 if smoke else 20_000
+    n_requests = 200 if smoke else max(int(1500 * _SCALE), 300)
+    threads = 8
+    entities = [f"u{i}" for i in range(E)]
+    # latency ring sized to ONE stream: a per-rep p99 read then covers
+    # exactly the newest rep, so best-of-reps compares clean windows
+    cfg = ServingConfig(max_batch=256, min_bucket=8, max_wait_s=0.002,
+                        max_queue=4096, latency_window=n_requests)
+
+    requests = []
+    for _ in range(n_requests):
+        k = int(rng.integers(1, 9))
+        requests.append((
+            {"global": rng.normal(size=(k, d_g)),
+             "per_user": rng.normal(size=(k, d_u))},
+            {"userId": np.asarray(
+                [entities[rng.integers(0, E)] for _ in range(k)],
+                dtype=object)}))
+
+    def run_stream(svc):
+        errors = []
+
+        def one(req):
+            try:
+                svc.score(*req)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            list(pool.map(one, requests))
+        return time.perf_counter() - t0, errors
+
+    def p99_of(svc):
+        return svc.metrics_snapshot()["latency_ms"]["p99"]
+
+    reps = 1 if smoke else 2
+
+    # phase A: no-update baseline.  Each phase runs `reps` streams and
+    # keeps its BEST p99 (the latency ring holds the newest window, so a
+    # per-rep read isolates each stream): on a shared-core box a single
+    # rep's p99 is scheduler roulette, and the gate should compare steady
+    # states, not which rep caught a cron tick.
+    svc_a = ScoringService(model=_online_model(rng, d_g, d_u, E), config=cfg)
+    try:
+        run_stream(svc_a)  # warm
+        p99s_a, walls_a, err_a = [], [], []
+        for _ in range(reps):
+            wall, errs = run_stream(svc_a)
+            walls_a.append(wall)
+            err_a += errs
+            p99s_a.append(p99_of(svc_a))
+        wall_a = min(walls_a)
+        snap_a = svc_a.metrics_snapshot()
+    finally:
+        svc_a.close()
+
+    # phase B: identical scoring stream with the updater live and a
+    # feedback pump publishing deltas the whole time
+    # freshness-tuned solver: a 25-iteration/1e-7 anchored solve moves the
+    # rows to within noise of the full solve (the anchor keeps steps small)
+    # while keeping each device dispatch short enough that scoring batches
+    # interleave — the single-device twin of the inexact-solve schedules
+    svc_b = ScoringService(
+        model=_online_model(rng, d_g, d_u, E), config=cfg,
+        updates=OnlineUpdateConfig(micro_batch=16, interval_s=0.005,
+                                   max_iterations=25, tolerance=1e-7,
+                                   max_pending_rows=32768))
+    try:
+        # the background loop warms the update path's compiled shapes
+        # before its first drain; measuring while those compiles hog the
+        # core would charge one-time costs to steady-state p99
+        deadline = time.time() + 120
+        while not svc_b.updater.warmed and time.time() < deadline:
+            time.sleep(0.05)
+        run_stream(svc_b)  # warm scoring buckets
+        f_rng = np.random.default_rng(47)
+        feats, ids, labels = _feedback_batch(f_rng, d_g, d_u, entities, 64)
+        svc_b.feedback(feats, ids, labels)
+        svc_b.updater.flush()
+        stop = _threading.Event()
+        pumped = [0]
+
+        def pump():
+            # rate-limit to roughly the updater's drain capacity: a pile-up
+            # would measure queue depth, not sustained feedback-to-publish
+            while not stop.is_set():
+                if svc_b.updater.buffer.pending_rows > 128:
+                    time.sleep(0.002)
+                    continue
+                f, i, l = _feedback_batch(f_rng, d_g, d_u, entities, 32)
+                try:
+                    svc_b.feedback(f, i, l)
+                    pumped[0] += 32
+                except Exception:
+                    time.sleep(0.005)  # backpressure: let the updater drain
+                time.sleep(0.002)
+
+        pumper = _threading.Thread(target=pump, daemon=True)
+        pumper.start()
+        t0 = time.perf_counter()
+        p99s_b, walls_b, err_b = [], [], []
+        for _ in range(reps):
+            wall, errs = run_stream(svc_b)
+            walls_b.append(wall)
+            err_b += errs
+            p99s_b.append(p99_of(svc_b))
+        wall_b = min(walls_b)
+        stop.set()
+        pumper.join(timeout=5)
+        svc_b.updater.flush()
+        update_wall = time.perf_counter() - t0
+        snap_b = svc_b.metrics_snapshot()
+    finally:
+        svc_b.close()
+
+    p99_a = min(p99s_a)
+    p99_b = min(p99s_b)
+    entities_updated = snap_b["online"]["entities_updated"]
+    ratio = p99_b / max(p99_a, 1e-9)
+    return {
+        "name": "online_latency",
+        "requests": n_requests, "threads": threads, "reps": reps,
+        "baseline": {"p99_ms": p99_a, "p99_ms_reps": p99s_a,
+                     "p50_ms": snap_a["latency_ms"]["p50"],
+                     "wall_s": round(wall_a, 3), "errors": len(err_a)},
+        "under_updates": {
+            "p99_ms": p99_b, "p99_ms_reps": p99s_b,
+            "p50_ms": snap_b["latency_ms"]["p50"],
+            "wall_s": round(wall_b, 3), "errors": len(err_b),
+            "feedback_rows_pumped": pumped[0],
+            "entities_updated": entities_updated,
+            "deltas_published": snap_b["online"]["deltas_published"],
+            "update_entities_per_sec": round(
+                entities_updated / update_wall, 1),
+            "feedback_to_publish_ms":
+                snap_b["online"]["feedback_to_publish_ms"],
+            "model_age_s": snap_b["model_age_s"],
+        },
+        "p99_ratio": round(ratio, 3),
+        "latency_gate": 1.5,
+        "latency_ok": bool(ratio <= 1.5 and not err_a and not err_b),
+    }
+
+
+def _online_traces_entry(smoke: bool) -> dict:
+    """Gate 3: a WARM serve loop absorbing a stream of deltas while
+    scoring runs traces NOTHING new — scorer buckets, the anchored batched
+    solver, fold/gather/scatter programs all stay cached."""
+    from photon_ml_tpu.online import OnlineUpdateConfig
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+
+    rng = np.random.default_rng(53)
+    d_g, d_u, E = 16, 8, 400
+    entities = [f"u{i}" for i in range(64)]
+    svc = ScoringService(
+        model=_online_model(rng, d_g, d_u, E),
+        config=ServingConfig(max_batch=64, min_bucket=4),
+        updates=OnlineUpdateConfig(micro_batch=8), start_updater=False)
+
+    def one_round(seed):
+        r = np.random.default_rng(seed)
+        f, i, l = _feedback_batch(r, d_g, d_u, entities, 32)
+        svc.feedback(f, i, l)
+        svc.updater.flush()
+        svc.score({"global": r.normal(size=(5, d_g)),
+                   "per_user": r.normal(size=(5, d_u))},
+                  {"userId": np.asarray(entities[:5], dtype=object)})
+
+    try:
+        # explicit warmup (what the background loop runs before its first
+        # drain) + one real round for the device_put paths
+        warmup_s = svc.updater.warmup()
+        warm_rounds = 1
+        for s in range(warm_rounds):
+            one_round(s)
+        steady_rounds = 3 if smoke else 12
+        with _trace_counting() as counter:
+            for s in range(warm_rounds, warm_rounds + steady_rounds):
+                one_round(s)
+        deltas = svc.registry.scorer.deltas_applied
+        return {
+            "name": "online_steady_state_traces",
+            "updater_warmup_s": round(warmup_s, 3),
+            "warm_rounds": warm_rounds, "steady_rounds": steady_rounds,
+            "deltas_absorbed": deltas,
+            "fresh_traces_steady_state": counter.count,
+            "zero_traces_ok": bool(counter.count == 0
+                                   and deltas >= steady_rounds),
+        }
+    finally:
+        svc.close()
+
+
+def _online_rollback_entry(smoke: bool, tmp_dir: str) -> dict:
+    """Gate 4: delta-aware rollback round-trips bit-exact after N delta
+    swaps, and a persisted delta survives a durable save/load round trip
+    byte-identically."""
+    from photon_ml_tpu.models.io import load_model_delta, save_model_delta
+    from photon_ml_tpu.online import OnlineUpdateConfig
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+
+    rng = np.random.default_rng(59)
+    d_g, d_u, E = 16, 8, 400
+    entities = [f"u{i}" for i in range(48)]
+    svc = ScoringService(
+        model=_online_model(rng, d_g, d_u, E),
+        config=ServingConfig(max_batch=64, min_bucket=4),
+        updates=OnlineUpdateConfig(micro_batch=8), start_updater=False)
+    try:
+        table0 = np.asarray(svc.registry.scorer.re_table("perUser")).copy()
+        rounds = 3 if smoke else 6
+        for s in range(rounds):
+            r = np.random.default_rng(100 + s)
+            f, i, l = _feedback_batch(r, d_g, d_u, entities, 32)
+            svc.feedback(f, i, l)
+            svc.updater.flush()
+        n_deltas = svc.registry.pending_deltas()
+        # durability: persist the newest delta, reload, byte-compare
+        delta = svc.registry.applied_deltas()[-1]
+        ddir = os.path.join(tmp_dir, "delta")
+        save_model_delta(delta, ddir)
+        loaded = load_model_delta(ddir)
+        cd, lcd = delta.coordinates["perUser"], loaded.coordinates["perUser"]
+        durable_ok = bool(
+            loaded.base_version == delta.base_version
+            and loaded.seq == delta.seq
+            and np.array_equal(cd.rows, lcd.rows)
+            and np.array_equal(cd.values, lcd.values)
+            and np.array_equal(cd.prior, lcd.prior))
+        changed = int(np.sum(np.any(
+            np.asarray(svc.registry.scorer.re_table("perUser")) != table0,
+            axis=1)))
+        svc.rollback()
+        table_rb = np.asarray(svc.registry.scorer.re_table("perUser"))
+        return {
+            "name": "online_rollback",
+            "deltas_applied": n_deltas, "rows_changed": changed,
+            "delta_durable_roundtrip_ok": durable_ok,
+            "rollback_bit_exact": bool(np.array_equal(table_rb, table0)),
+            "rollback_ok": bool(np.array_equal(table_rb, table0)
+                                and n_deltas >= rounds and changed > 0
+                                and durable_ok),
+        }
+    finally:
+        svc.close()
+
+
+def online_bench(out_path="BENCH_online.json", smoke=False, max_wall=None):
+    """Online-learning gate (--online): (1) online-updated entity rows
+    match an offline refit of the same entities in f64 (<= 1e-6 rel, plus
+    a scipy oracle); (2) scoring p99 under sustained concurrent update
+    load <= 1.5x the no-update baseline; (3) zero fresh XLA traces across
+    steady-state delta application; (4) delta-aware rollback round-trips
+    bit-exact and deltas persist durably.  `value` is the sustained
+    update throughput (entities/sec) concurrent with scoring traffic."""
+    import tempfile
+
+    import jax
+    jax.config.update("jax_enable_x64", True)  # f64 parity gates
+    t0 = time.perf_counter()
+    entries = []
+    truncated = []
+    legs = [
+        ("online_parity", lambda: _online_parity_entry(smoke)),
+        ("online_traces", lambda: _online_traces_entry(smoke)),
+        ("online_rollback", None),  # needs tmp dir, handled below
+        ("online_latency", lambda: _online_latency_entry(smoke)),
+    ]
+    with tempfile.TemporaryDirectory() as tmp:
+        for name, fn in legs:
+            if max_wall is not None and time.perf_counter() - t0 > max_wall:
+                truncated.append(name)
+                continue
+            if name == "online_rollback":
+                entries.append(_online_rollback_entry(smoke, tmp))
+            else:
+                entries.append(fn())
+    by_name = {e["name"]: e for e in entries}
+    parity = by_name.get("online_parity", {})
+    latency = by_name.get("online_latency", {})
+    traces = by_name.get("online_steady_state_traces", {})
+    rollback = by_name.get("online_rollback", {})
+    gates = {
+        "parity_ok": parity.get("parity_ok"),
+        "latency_ok": latency.get("latency_ok"),
+        "zero_traces_ok": traces.get("zero_traces_ok"),
+        "rollback_ok": rollback.get("rollback_ok"),
+    }
+    # smoke runs under the tier-1 suite on shared CPUs: latency is a smoke
+    # signal there, a HARD gate on the full (committed) bench run
+    hard = ["parity_ok", "zero_traces_ok", "rollback_ok"]
+    if not smoke:
+        hard.append("latency_ok")
+    result = {
+        "metric": "online_update_entities_per_sec",
+        "value": (latency.get("under_updates", {})
+                  .get("update_entities_per_sec", 0.0)),
+        "unit": "entities/sec",
+        "detail": {
+            "smoke": smoke,
+            "entries": entries,
+            **gates,
+            "all_ok": all(bool(gates[g]) for g in hard),
+            "hard_gates": hard,
+            "truncated": truncated or False,
+            "suite_wall_s": round(time.perf_counter() - t0, 1),
+        },
+    }
+    _embed_telemetry(result)
+    tmp_path = out_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp_path, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 
 def warm_ref_cache():
     """Compute every GLM config's float64 CPU reference (optimum + solve
@@ -2952,6 +3399,13 @@ def _dispatch():
         warm_ref_cache()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
         serve_bench(*sys.argv[2:3])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--online":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        online_bench(*(paths[:1] or ["BENCH_online.json"]), smoke=smoke,
+                     max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--pipeline":
         pipeline_bench(*sys.argv[2:3])
     elif len(sys.argv) > 1 and sys.argv[1] == "--stream":

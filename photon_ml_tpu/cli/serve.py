@@ -11,12 +11,25 @@ HTTP mode (default) — a dependency-free stdlib server:
   POST /score    {"features": {shard: [[...]]}, "ids": {type: [...]},
                   "timeout_ms": 50}        -> {"scores": [...]}
   POST /predict  same body                 -> {"predictions": [...]}
+  POST /feedback same body + "labels" (opt "weights"/"offsets"/
+                  "event_ids")             -> 202 intake accounting
+                                              (--enable-updates only: the
+                                              online tier re-solves the
+                                              touched entities' random
+                                              effects and publishes
+                                              row-level delta swaps)
   GET  /metrics                            -> Prometheus text exposition
-                                              (0.0.4; scrape this)
+                                              (0.0.4; scrape this —
+                                              includes serve.model_age_s
+                                              and online.* instruments)
   GET  /metrics.json                       -> ServingMetrics JSON snapshot
   POST /swap     {"model_dir": "..."}      -> zero-downtime hot swap
-  POST /rollback                           -> previous version
-  GET  /healthz
+  POST /rollback                           -> delta-aware: pending delta
+                                              swaps revert to exact
+                                              pre-delta rows, else the
+                                              previous full model
+  GET  /healthz                            -> status + version vector
+                                              (model version, delta seq)
 
   429 = Overloaded (queue full), 504 = DeadlineExceeded, 400 = bad request.
   SIGUSR1 dumps a metrics snapshot to stderr; --metrics-interval dumps one
@@ -60,6 +73,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-interval", type=float, default=0.0,
                    help="seconds between periodic metrics dumps to stderr "
                         "(0 = only on SIGUSR1)")
+    p.add_argument("--enable-updates", action="store_true",
+                   help="online learning tier: accept POST /feedback and "
+                        "publish per-entity random-effect delta swaps "
+                        "into the live scorer")
+    p.add_argument("--update-interval-ms", type=float, default=20.0,
+                   help="idle poll period of the online update loop")
+    p.add_argument("--update-micro-batch", type=int, default=16,
+                   help="entity lanes per anchored online solve "
+                        "(power-of-two rounded)")
+    p.add_argument("--update-anchor-weight", type=float, default=1.0,
+                   help="prior-pull strength toward the batch solution "
+                        "(lambda of ||c - c0||^2)")
+    p.add_argument("--update-max-rows-per-entity", type=int, default=64,
+                   help="per-entity sample ceiling per online solve "
+                        "(newest rows win)")
+    p.add_argument("--feedback-max-pending", type=int, default=8192,
+                   help="pending feedback rows before backpressure "
+                        "(Overloaded / HTTP 429)")
     p.add_argument("--event-listener", action="append", default=[],
                    help="dotted EventListener class path (repeatable); "
                         "receives ScoringBatchEvent/ModelSwapEvent")
@@ -90,8 +121,17 @@ def _build_service(args):
         min_bucket=args.min_bucket,
         default_timeout_s=(None if args.default_timeout_ms is None
                            else args.default_timeout_ms / 1e3))
+    updates = None
+    if args.enable_updates:
+        from photon_ml_tpu.online import OnlineUpdateConfig
+        updates = OnlineUpdateConfig(
+            micro_batch=args.update_micro_batch,
+            max_rows_per_entity=args.update_max_rows_per_entity,
+            anchor_weight=args.update_anchor_weight,
+            interval_s=args.update_interval_ms / 1e3,
+            max_pending_rows=args.feedback_max_pending)
     return ScoringService(model_dir=args.model_dir, config=cfg,
-                          emitter=emitter)
+                          emitter=emitter, updates=updates)
 
 
 def _dump_metrics(service, stream=sys.stderr):
@@ -208,7 +248,9 @@ def _make_http_server(service, host: str, port: int):
             elif self.path == "/healthz":
                 self._reply(200, {
                     "status": "ok",
-                    "model_version": service.model_version})
+                    "model_version": service.model_version,
+                    "version_vector": service.version_vector(),
+                    "updates_enabled": service.updater is not None})
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -233,6 +275,24 @@ def _make_http_server(service, host: str, port: int):
                         key = "predictions"
                     self._reply(200, {key: np.asarray(out).tolist(),
                                       "model_version": service.model_version})
+                elif self.path == "/feedback":
+                    if service.updater is None:
+                        return self._reply(400, {
+                            "error": "online updates are not enabled "
+                                     "(start with --enable-updates)"})
+                    feats = {s: np.asarray(v, np.float64)
+                             for s, v in (req.get("features") or {}).items()}
+                    ids = {t: np.asarray(v, dtype=object)
+                           for t, v in (req.get("ids") or {}).items()}
+                    if req.get("labels") is None:
+                        return self._reply(400, {"error": "labels required"})
+                    out = service.feedback(
+                        feats, ids, np.asarray(req["labels"], np.float64),
+                        weights=req.get("weights"),
+                        offsets=req.get("offsets"),
+                        event_ids=req.get("event_ids"))
+                    out["version_vector"] = service.version_vector()
+                    self._reply(202, out)
                 elif self.path == "/swap":
                     if not req.get("model_dir"):
                         return self._reply(400,
@@ -280,8 +340,9 @@ def main(argv=None) -> int:
         "model_version": service.model_version,
         "model_load_s": round(load_s, 3),
         "buckets": service.registry.scorer.bucket_sizes(),
-        "endpoints": ["/score", "/predict", "/metrics", "/metrics.json",
-                      "/swap", "/rollback", "/healthz"],
+        "updates_enabled": service.updater is not None,
+        "endpoints": ["/score", "/predict", "/feedback", "/metrics",
+                      "/metrics.json", "/swap", "/rollback", "/healthz"],
     }), flush=True)
     try:
         httpd.serve_forever(poll_interval=0.2)

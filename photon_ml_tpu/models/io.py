@@ -799,3 +799,64 @@ def load_glm(directory: str):
     coeffs = Coefficients(jnp.asarray(z["means"]),
                           jnp.asarray(z["variances"]) if "variances" in z else None)
     return model_for_task(meta["task_type"], coeffs), meta
+
+
+# -- online model deltas ------------------------------------------------------
+
+def save_model_delta(delta, directory: str) -> None:
+    """Durable persistence of an online ModelDelta (photon_ml_tpu/online):
+    the audit/replication artifact of a row-level delta swap.
+
+    Write discipline matches checkpoints: the npz lands via tmp + fsync +
+    atomic replace, metadata via atomic_write_json, and a per-file
+    size+sha256 manifest.json is written LAST — at any crash instant the
+    directory either verifies complete or is detectably partial
+    (load_model_delta refuses the latter)."""
+    from photon_ml_tpu.utils.durable import (fsync_dir, fsync_file,
+                                             write_manifest)
+    os.makedirs(directory, exist_ok=True)
+    npz_path = os.path.join(directory, "delta.npz")
+    tmp = npz_path + ".tmp.npz"   # savez appends .npz to unsuffixed paths
+    np.savez_compressed(tmp, **delta.to_arrays())
+    fsync_file(tmp)
+    os.replace(tmp, npz_path)
+    fsync_dir(directory)
+    atomic_write_json(os.path.join(directory, "delta-metadata.json"), {
+        "format_version": _FORMAT_VERSION,
+        "base_version": delta.base_version,
+        "delta_seq": delta.seq,
+        "created_at": delta.created_at,
+        "coordinates": {name: cd.num_rows
+                        for name, cd in delta.coordinates.items()},
+        "num_rows": delta.num_rows,
+    })
+    write_manifest(directory)
+
+
+def load_model_delta(directory: str):
+    """Load + VERIFY a persisted ModelDelta: the manifest must be present
+    and every file must match its recorded size and sha256 (a torn or
+    tampered delta must never reach apply_delta)."""
+    from photon_ml_tpu.online.delta import ModelDelta
+    from photon_ml_tpu.utils.durable import file_sha256
+    manifest_p = os.path.join(directory, "manifest.json")
+    if not os.path.exists(manifest_p):
+        raise FileNotFoundError(
+            f"no manifest.json in {directory!r} — the delta write did not "
+            "complete (or this is not a delta directory)")
+    with open(manifest_p) as f:
+        manifest = json.load(f)
+    for rel, want in manifest.get("files", {}).items():
+        p = os.path.join(directory, rel)
+        if not os.path.exists(p) or os.path.getsize(p) != want["bytes"] \
+                or file_sha256(p) != want["sha256"]:
+            raise ValueError(
+                f"delta file {rel!r} in {directory!r} does not match its "
+                "manifest (size/sha256) — refusing to load a torn or "
+                "tampered delta")
+    with open(os.path.join(directory, "delta-metadata.json")) as f:
+        meta = json.load(f)
+    z = np.load(os.path.join(directory, "delta.npz"), allow_pickle=True)
+    return ModelDelta.from_arrays(
+        {k: z[k] for k in z.files}, base_version=meta["base_version"],
+        seq=meta["delta_seq"], created_at=meta.get("created_at", 0.0))

@@ -1,0 +1,38 @@
+"""Online learning tier: per-entity random-effect updates into the live
+scorer, without a full refit or a full-model cutover.
+
+The serving half (photon_ml_tpu/serving/) is read-only between hot swaps;
+production GLMix freshness comes from cheap random-effect-only refits —
+the per-entity subproblems are independent (the executor-sharding insight
+of the source paper; arXiv 1611.02101, 1803.06333), so entities with new
+feedback re-solve in milliseconds while the fixed effect stays frozen.
+Three pieces:
+
+  - `feedback.FeedbackBuffer` — bounded intake coalescing labeled
+    observations per (coordinate, entity), backpressure -> Overloaded,
+    per-entity dedup window.
+  - `updater.OnlineUpdater` — background loop draining touched entities
+    into the batched RE solver's padded pow-2 layout at micro-batch size,
+    each entity's subproblem ANCHORED at its current coefficients
+    (game/anchored.py: warm start + prior-pull regularization, so a few
+    fresh rows refine rather than replace the batch solution); non-finite
+    solves freeze the entity (never the live table); fault sites
+    `online.solve` / `online.publish` retry transiently like chunk
+    staging.
+  - `delta.ModelDelta` — the changed rows of the stacked RE tables + a
+    version vector; `ModelRegistry.apply_delta` scatters them into the
+    device-resident tables under the registry lock (zero fresh XLA traces
+    steady-state) and `rollback()` restores exact pre-delta rows.
+
+Wire-up: `ScoringService(..., updates=OnlineUpdateConfig())` or
+`cli.serve --enable-updates` (POST /feedback); staleness + update metrics
+ride the serving `GET /metrics` surfaces; delta serialization lives in
+models/io.py (`save_model_delta` / `load_model_delta`, durable writes).
+"""
+from photon_ml_tpu.online.delta import CoordinateDelta, ModelDelta  # noqa: F401
+from photon_ml_tpu.online.feedback import (  # noqa: F401
+    EntityFeedback, FeedbackBuffer, Observation,
+)
+from photon_ml_tpu.online.updater import (  # noqa: F401
+    OnlineUpdateConfig, OnlineUpdater,
+)

@@ -12,11 +12,17 @@ this package is the serving half of the ROADMAP north star.  Four pieces:
     calls coalesce into one padded device call, with max-wait / max-batch
     knobs and load shedding (`Overloaded`, `DeadlineExceeded`).
   - `registry.ModelRegistry` — versioned scorers with zero-downtime hot
-    swap and rollback.
+    swap, row-level delta swaps (`apply_delta`, the online tier's publish
+    path) and delta-aware rollback (exact pre-delta rows restored).
   - `service.ScoringService` — the assembled in-process service, with
     `metrics.ServingMetrics` observability (latency percentiles, batch
-    occupancy, entity hit-rate, shed counts) and
-    ScoringBatchEvent/ModelSwapEvent hooks (utils/events.py).
+    occupancy, entity hit-rate, shed counts, model staleness and online
+    feedback-to-publish latency) and ScoringBatchEvent/ModelSwapEvent/
+    ModelDeltaEvent hooks (utils/events.py).
+
+The online learning tier on top of this package lives in
+photon_ml_tpu/online/ (`ScoringService(updates=...)` / cli.serve
+--enable-updates).
 
 CLI entrypoint: `python -m photon_ml_tpu.cli.serve`.
 """
@@ -24,7 +30,9 @@ from photon_ml_tpu.serving.batcher import (  # noqa: F401
     BatcherConfig, DeadlineExceeded, MicroBatcher, Overloaded, ServingError,
 )
 from photon_ml_tpu.serving.metrics import ServingMetrics  # noqa: F401
-from photon_ml_tpu.serving.registry import ModelRegistry  # noqa: F401
+from photon_ml_tpu.serving.registry import (  # noqa: F401
+    ModelRegistry, StaleDeltaError,
+)
 from photon_ml_tpu.serving.scorer import CompiledScorer  # noqa: F401
 from photon_ml_tpu.serving.service import (  # noqa: F401
     ScoringService, ServingConfig,

@@ -62,6 +62,34 @@ class ServingMetrics:
         self._score_time = r.counter("serving.batch_score_s")
         self._latency = r.histogram("serving.latency_s",
                                     reservoir=latency_window)
+        # -- online-update tier (photon_ml_tpu/online/) --------------------
+        # staleness: seconds since the live model last changed (full swap
+        # OR row-level delta publish); the gauge is refreshed at render
+        # time so a scrape always sees the current age
+        self._model_age = r.gauge("serve.model_age_s")
+        self._last_model_change = time.monotonic()
+        self._feedback_requests = r.counter("online.feedback_requests")
+        self._feedback_rows = r.counter("online.feedback_rows")
+        self._feedback_lanes = r.counter("online.feedback_lane_rows")
+        self._feedback_unseen = r.counter("online.feedback_dropped_unseen")
+        self._feedback_frozen = r.counter("online.feedback_dropped_frozen")
+        self._feedback_deduped = r.counter("online.feedback_deduped")
+        self._feedback_coalesced = r.counter("online.feedback_coalesced")
+        self._feedback_shed = r.counter("online.feedback_shed")
+        self._updates = r.counter("online.update_cycles")
+        self._entities_updated = r.counter("online.entities_updated")
+        self._rows_trained = r.counter("online.rows_trained")
+        self._deltas = r.counter("online.deltas_published")
+        self._delta_rows = r.counter("online.delta_rows")
+        self._stale_deltas = r.counter("online.stale_deltas")
+        self._frozen_entities = r.counter("online.frozen_entities")
+        self._solve_retries = r.counter("online.solve_retries")
+        self._solve_failures = r.counter("online.solve_failures")
+        self._publish_time = r.counter("online.publish_s")
+        # per-entity feedback-to-publish latency (enqueue of an entity's
+        # OLDEST pending observation -> its row live in the scorer tables)
+        self._f2p = r.histogram("online.feedback_to_publish_s",
+                                reservoir=latency_window)
 
     # counter-value conveniences (tests and embedding callers read these
     # like the old plain-int attributes)
@@ -125,6 +153,61 @@ class ServingMetrics:
 
     def observe_swap(self, rollback: bool = False) -> None:
         (self._rollbacks if rollback else self._swaps).inc()
+        with self._lock:
+            self._last_model_change = time.monotonic()
+
+    # -- online-update tier -------------------------------------------------
+
+    def observe_feedback(self, *, requests: int = 1, rows: int = 0,
+                         lane_rows: int = 0, unseen: int = 0,
+                         frozen: int = 0, deduped: int = 0,
+                         coalesced: int = 0) -> None:
+        with self._lock:
+            self._feedback_requests.inc(requests)
+            self._feedback_rows.inc(rows)
+            self._feedback_lanes.inc(lane_rows)
+            self._feedback_unseen.inc(unseen)
+            self._feedback_frozen.inc(frozen)
+            self._feedback_deduped.inc(deduped)
+            self._feedback_coalesced.inc(coalesced)
+
+    def observe_feedback_shed(self) -> None:
+        self._feedback_shed.inc()
+
+    def observe_update_cycle(self, *, entities: int, rows: int) -> None:
+        with self._lock:
+            self._updates.inc()
+            self._entities_updated.inc(entities)
+            self._rows_trained.inc(rows)
+
+    def observe_delta(self, *, rows: int, publish_s: float = 0.0) -> None:
+        """A delta landed in the live tables: the model just changed."""
+        with self._lock:
+            self._deltas.inc()
+            self._delta_rows.inc(rows)
+            self._publish_time.inc(publish_s)
+            self._last_model_change = time.monotonic()
+
+    def observe_feedback_to_publish(self, latency_s: float) -> None:
+        self._f2p.observe(latency_s)
+
+    def observe_stale_delta(self) -> None:
+        self._stale_deltas.inc()
+
+    def observe_frozen_entity(self, n: int = 1) -> None:
+        self._frozen_entities.inc(n)
+
+    def observe_solve_retry(self) -> None:
+        self._solve_retries.inc()
+
+    def observe_solve_failure(self) -> None:
+        self._solve_failures.inc()
+
+    def _refresh_model_age(self) -> float:
+        with self._lock:
+            age = time.monotonic() - self._last_model_change
+        self._model_age.set(round(age, 3))
+        return age
 
     # -- reporting ---------------------------------------------------------
 
@@ -171,11 +254,54 @@ class ServingMetrics:
             out["latency_ms"]["window"] = h["window"]
         else:
             out["latency_ms"] = None
+        out["model_age_s"] = round(self._refresh_model_age(), 3)
+        out["online"] = self._online_snapshot()
         if model_version is not None:
             out["model_version"] = model_version
         return out
 
+    def _online_snapshot(self) -> Dict:
+        """The online-update tier's state (all zeros when updates are
+        disabled — the instruments exist either way)."""
+        f2p = self._f2p.snapshot()
+        deltas = self._deltas.value
+        out = {
+            "feedback_requests": self._feedback_requests.value,
+            "feedback_rows": self._feedback_rows.value,
+            "feedback_lane_rows": self._feedback_lanes.value,
+            "dropped_unseen": self._feedback_unseen.value,
+            "dropped_frozen": self._feedback_frozen.value,
+            "deduped": self._feedback_deduped.value,
+            "coalesced": self._feedback_coalesced.value,
+            "shed": self._feedback_shed.value,
+            "update_cycles": self._updates.value,
+            "entities_updated": self._entities_updated.value,
+            "rows_trained": self._rows_trained.value,
+            "deltas_published": deltas,
+            "delta_rows": self._delta_rows.value,
+            "stale_deltas": self._stale_deltas.value,
+            "frozen_entities": self._frozen_entities.value,
+            "solve_retries": self._solve_retries.value,
+            "solve_failures": self._solve_failures.value,
+            "mean_publish_ms": round(
+                1e3 * self._publish_time.value / deltas, 3)
+            if deltas else None,
+        }
+        if f2p["count"]:
+            out["feedback_to_publish_ms"] = {
+                key: round(1e3 * f2p[src], 3)
+                for key, src in (("p50", "p50"), ("p99", "p99"),
+                                 ("max", "max"))
+            }
+            out["feedback_to_publish_ms"]["window"] = f2p["window"]
+        else:
+            out["feedback_to_publish_ms"] = None
+        return out
+
     def prometheus(self, model_version: Optional[str] = None) -> str:
-        """Prometheus text exposition of every serving instrument."""
+        """Prometheus text exposition of every serving instrument
+        (including the online tier's staleness gauge and the
+        feedback-to-publish latency summary)."""
+        self._refresh_model_age()
         info = {"model_version": model_version} if model_version else None
         return prometheus_text(self.registry, extra_info=info)

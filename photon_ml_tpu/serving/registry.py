@@ -1,4 +1,5 @@
-"""Versioned scorer registry: zero-downtime hot swap + rollback.
+"""Versioned scorer registry: zero-downtime hot swap, row-level delta
+swaps, and delta-aware rollback.
 
 `load(version_dir)` does ALL the heavy work — model load, device transfer,
 bucket warm-up compiles — on the calling (or a background) thread while the
@@ -7,22 +8,51 @@ the lock.  In-flight batches hold their own reference to the old scorer
 (the batcher resolves the current scorer per batch), so a swap is atomic
 at batch granularity and nothing is dropped.  The previous version is kept
 for `rollback()`.
+
+Row-level deltas (the online tier, photon_ml_tpu/online/): `apply_delta`
+scatters a ModelDelta's changed random-effect rows into the LIVE scorer's
+device tables under the registry lock — no full-model cutover, no fresh
+XLA traces.  The delta's version vector must match the live version
+(`StaleDeltaError` otherwise: rows solved against a superseded model must
+never land on its successor), and every applied delta is kept on an undo
+log so `rollback()` is DELTA-AWARE: with pending deltas it restores the
+exact pre-delta rows (newest first — bit-exact round trip); with none it
+falls back to the full-model previous-version swap.  A full-model rollback
+restores the previous scorer AS LAST SERVED, i.e. including any deltas it
+had absorbed before being swapped out.
 """
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Callable, Optional, Tuple
 
 from photon_ml_tpu.serving.scorer import CompiledScorer
-from photon_ml_tpu.utils.events import EventEmitter, ModelSwapEvent
+from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils.events import (EventEmitter, ModelDeltaEvent,
+                                        ModelSwapEvent)
+
+
+class StaleDeltaError(RuntimeError):
+    """A delta's base_version no longer matches the live scorer (a full
+    swap landed between solve and publish).  The publisher should re-solve
+    against the new version — applying anyway would scatter rows computed
+    against stale residual margins."""
+
+
+#: undo-log depth: deltas are a few KB each, so this bounds memory at a
+#: few MB while keeping hours of update history rollback-able.  When the
+#: log overflows, the OLDEST records drop and delta rollback refuses
+#: (partial restoration would not be the exact pre-delta state).
+MAX_DELTA_LOG = 4096
 
 
 class ModelRegistry:
     def __init__(self, scorer_factory: Optional[Callable] = None,
                  emitter: Optional[EventEmitter] = None,
-                 metrics=None):
+                 metrics=None, max_delta_log: int = MAX_DELTA_LOG):
         """`scorer_factory(version_dir, version)` -> warmed CompiledScorer;
         defaults to `CompiledScorer.from_model_dir`."""
         self._factory = scorer_factory or (
@@ -33,6 +63,10 @@ class ModelRegistry:
         self._counter = 0
         self._current: Optional[Tuple[str, CompiledScorer]] = None
         self._previous: Optional[Tuple[str, CompiledScorer]] = None
+        self._max_delta_log = int(max_delta_log)
+        self._delta_log: deque = deque()
+        self._delta_log_truncated = False
+        self._delta_seq = 0
 
     @property
     def scorer(self) -> CompiledScorer:
@@ -73,10 +107,21 @@ class ModelRegistry:
         of `load`; also the path for swapping in an in-memory model)."""
         if not getattr(scorer, "warmed", True):
             scorer.warmup()
+        # the scorer must carry the version it is installed under: delta
+        # publishers stamp `scorer.version` into their version vector, and
+        # a None/mismatched version would refuse every delta as stale
+        scorer.version = version
         with self._lock:
             previous = self._current
             self._previous = previous
             self._current = (version, scorer)
+            # the undo log belongs to the outgoing version: a new full
+            # model starts pristine (the previous scorer keeps its
+            # absorbed deltas in its tables — that is the state it last
+            # served, and what a full-model rollback restores)
+            self._delta_log.clear()
+            self._delta_log_truncated = False
+            self._delta_seq = 0
         if self._metrics is not None:
             self._metrics.observe_swap()
         self._emit(ModelSwapEvent(
@@ -101,18 +146,101 @@ class ModelRegistry:
                          name="photon-serving-swap").start()
         return fut
 
-    def rollback(self) -> str:
-        """Swap back to the previous version (single-level undo)."""
+    # -- row-level delta swaps (the online tier's publish path) -------------
+
+    def next_delta_seq(self) -> int:
+        """Reserve the next delta sequence number for the live version
+        (the publisher stamps it into the ModelDelta it is building)."""
         with self._lock:
-            if self._previous is None:
-                raise RuntimeError("no previous model version to roll back to")
-            rolled_from = self._current
-            self._current, self._previous = self._previous, rolled_from
-            version = self._current[0]
+            return self._delta_seq + 1
+
+    def apply_delta(self, delta, publish_s: float = 0.0) -> dict:
+        """Scatter a ModelDelta's rows into the LIVE scorer under the
+        lock.  Verifies the version vector (StaleDeltaError on mismatch)
+        and appends the delta to the undo log.  Returns the resulting
+        version vector."""
+        faults.fire("online.publish",
+                    coordinate=",".join(sorted(delta.coordinates)))
+        with self._lock:
+            if self._current is None:
+                raise RuntimeError("no model loaded")
+            version, scorer = self._current
+            if delta.base_version != version:
+                raise StaleDeltaError(
+                    f"delta was solved against version "
+                    f"{delta.base_version!r} but {version!r} is live — "
+                    "re-solve against the current model")
+            scorer.apply_delta(delta)
+            self._delta_seq = delta.seq
+            self._delta_log.append(delta)
+            if len(self._delta_log) > self._max_delta_log:
+                self._delta_log.popleft()
+                self._delta_log_truncated = True
+            pending = len(self._delta_log)
+        if self._metrics is not None:
+            self._metrics.observe_delta(rows=delta.num_rows,
+                                        publish_s=publish_s)
+        self._emit(ModelDeltaEvent(
+            time=time.time(), version=version, delta_seq=delta.seq,
+            coordinates={n: cd.num_rows
+                         for n, cd in delta.coordinates.items()},
+            num_rows=delta.num_rows, publish_s=publish_s))
+        return {"version": version, "delta_seq": delta.seq,
+                "pending_deltas": pending}
+
+    def pending_deltas(self) -> int:
+        """Deltas applied to the live version and still rollback-able."""
+        with self._lock:
+            return len(self._delta_log)
+
+    def applied_deltas(self) -> tuple:
+        """Snapshot of the live version's undo log, oldest first (audit /
+        replication: models.io.save_model_delta persists these)."""
+        with self._lock:
+            return tuple(self._delta_log)
+
+    def version_vector(self) -> dict:
+        with self._lock:
+            version = None if self._current is None else self._current[0]
+            seq = self._delta_seq
+        return {"version": version, "delta_seq": seq}
+
+    def rollback(self) -> str:
+        """Delta-aware single-level undo.
+
+        With pending deltas: restore the exact pre-delta rows (reverting
+        newest-first, so rows touched by several deltas land back on their
+        original values bit-exactly) and stay on the current full-model
+        version.  With none: swap back to the previous full model."""
+        with self._lock:
+            if self._delta_log:
+                if self._delta_log_truncated:
+                    raise RuntimeError(
+                        "delta undo log overflowed (oldest records "
+                        "dropped): an exact pre-delta restore is no "
+                        "longer possible — roll back by swapping a "
+                        "full model version instead")
+                version, scorer = self._current
+                reverted = 0
+                while self._delta_log:
+                    scorer.revert_delta(self._delta_log.pop())
+                    reverted += 1
+                self._delta_seq = 0
+                rolled_from = None
+            else:
+                if self._previous is None:
+                    raise RuntimeError(
+                        "no previous model version to roll back to")
+                rolled_from = self._current
+                self._current, self._previous = self._previous, rolled_from
+                version = self._current[0]
+                reverted = 0
+                self._delta_seq = self._current[1].delta_seq
         if self._metrics is not None:
             self._metrics.observe_swap(rollback=True)
         self._emit(ModelSwapEvent(
             time=time.time(), version=version,
-            previous_version=None if rolled_from is None else rolled_from[0],
-            action="rollback"))
+            previous_version=(None if rolled_from is None
+                              else rolled_from[0]),
+            action="delta_rollback" if reverted else "rollback"))
         return version

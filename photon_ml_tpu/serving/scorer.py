@@ -58,6 +58,38 @@ def _id_lookup(entity_ids: np.ndarray) -> dict:
     return {v: i for i, v in enumerate(np.asarray(entity_ids).tolist())}
 
 
+@jax.jit
+def _scatter_rows(table, rows, values):
+    """Row-level delta swap: scatter changed rows into a stacked table.
+    Padding lanes carry an out-of-range row index and DROP, so one
+    compiled program per (table shape, pow-2 row count) covers every
+    delta — steady-state updates trace nothing new."""
+    return table.at[rows].set(values, mode="drop")
+
+
+@jax.jit
+def _gather_rows(table, rows):
+    """Row gather for delta priors (pad lanes clamp to row 0; callers mask
+    them out host-side)."""
+    return table[jnp.maximum(rows, 0)]
+
+
+def _pad_pow2_rows(rows: np.ndarray, values: np.ndarray, num_table_rows: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad a row-update set to the next power of two with out-of-range
+    (dropped) scatter lanes, so delta row counts map onto a bounded set of
+    compiled scatter shapes."""
+    k = len(rows)
+    pad = int(ceil_pow2(max(k, 1))) - k
+    if pad == 0:
+        return rows, values
+    rows_p = np.concatenate(
+        [rows, np.full(pad, num_table_rows, dtype=rows.dtype)])
+    values_p = np.concatenate(
+        [values, np.zeros((pad, values.shape[1]), values.dtype)])
+    return rows_p, values_p
+
+
 def _resolve_lanes(lookup: dict, ids: np.ndarray) -> np.ndarray:
     return np.fromiter((lookup.get(v, -1) for v in np.asarray(ids).tolist()),
                        dtype=np.int32, count=len(ids))
@@ -89,6 +121,7 @@ class CompiledScorer:
         self._re_meta: List[Tuple[str, str, str]] = []     # (name, shard, re_type)
         self._mf_meta: List[Tuple[str, str, str]] = []     # (name, row_t, col_t)
         self._lookups: Dict[str, dict] = {}                # lane key -> id map
+        self._table_slot: Dict[str, int] = {}              # RE name -> slot
         tables = []
         shard_dims: Dict[str, int] = {}
 
@@ -114,6 +147,7 @@ class CompiledScorer:
                 self._re_meta.append((name, m.feature_shard,
                                       m.random_effect_type))
                 self._lookups[name] = _id_lookup(m.entity_ids)
+                self._table_slot[name] = len(tables)
                 tables.append(table)
             elif isinstance(m, MatrixFactorizationModel):
                 self._mf_meta.append((name, m.row_effect_type,
@@ -141,6 +175,11 @@ class CompiledScorer:
         self.bucket_compiles = 0
         self.warmup_s = 0.0
         self.warmed = False
+        # online-update version vector: seq of the newest applied delta
+        # (0 = pristine full-model load) + lifetime apply/revert counts
+        self.delta_seq = 0
+        self.deltas_applied = 0
+        self.deltas_reverted = 0
 
     # -- construction ------------------------------------------------------
 
@@ -212,6 +251,77 @@ class CompiledScorer:
         xs = {s: jnp.asarray(x, self._dtype) for s, x in xs.items()}
         lanes = {k: jnp.asarray(v) for k, v in lanes.items()}
         return self._program(self._tables, xs, lanes)
+
+    # -- online row-level updates ------------------------------------------
+
+    def updatable_coordinates(self) -> List[Tuple[str, str, str]]:
+        """(name, feature_shard, re_type) of every coordinate whose stacked
+        table accepts row-level delta swaps (plain + factored random
+        effects; MF factor pairs are not online-updatable — prefer a full
+        refit there)."""
+        return list(self._re_meta)
+
+    def re_table(self, name: str) -> jax.Array:
+        """The device-resident stacked [E, d] table of one RE coordinate
+        (original shard space — what apply_delta scatters into)."""
+        return self._tables[self._table_slot[name]]
+
+    def entity_row(self, name: str, entity_id) -> int:
+        """Table row of a raw entity id under coordinate `name`
+        (-1 = unseen at training time; such entities cannot be
+        online-updated — the table has no row to anchor at)."""
+        return self._lookups[name].get(entity_id, -1)
+
+    def gather_rows(self, name: str, rows: np.ndarray) -> jax.Array:
+        """Device gather of table rows (delta priors / anchors)."""
+        return _gather_rows(self.re_table(name),
+                            jnp.asarray(np.asarray(rows, np.int64)))
+
+    def _scatter_coordinate(self, name: str, rows: np.ndarray,
+                            values: np.ndarray) -> None:
+        slot = self._table_slot.get(name)
+        if slot is None:
+            known = sorted(self._table_slot)
+            raise KeyError(f"coordinate {name!r} has no online-updatable "
+                           f"table (updatable: {known})")
+        table = self._tables[slot]
+        rows = np.asarray(rows, np.int64)
+        values = np.asarray(values)
+        if values.shape != (len(rows), table.shape[1]):
+            raise ValueError(
+                f"delta values for {name!r} must be [{len(rows)}, "
+                f"{table.shape[1]}], got {values.shape}")
+        if len(rows) and int(rows.max()) >= table.shape[0]:
+            raise ValueError(
+                f"delta row {int(rows.max())} out of range for {name!r} "
+                f"(table has {table.shape[0]} rows)")
+        rows_p, values_p = _pad_pow2_rows(rows, values, table.shape[0])
+        new_table = _scatter_rows(table, jnp.asarray(rows_p),
+                                  jnp.asarray(values_p, table.dtype))
+        tables = list(self._tables)
+        tables[slot] = new_table
+        # one atomic tuple swap: a concurrent score() batch reads either
+        # the old or the new tuple — batch-granularity consistency, same
+        # contract as a full-model hot swap
+        self._tables = tuple(tables)
+
+    def apply_delta(self, delta) -> None:
+        """Scatter a ModelDelta's changed rows into the live tables.
+        Callers serialize through the registry lock; scoring threads need
+        no lock (the table tuple swap is atomic, and the compiled bucket
+        programs take tables as traced ARGUMENTS, so no re-trace)."""
+        for name, cd in delta.coordinates.items():
+            self._scatter_coordinate(name, cd.rows, cd.values)
+        self.delta_seq = delta.seq
+        self.deltas_applied += 1
+
+    def revert_delta(self, delta) -> None:
+        """Scatter a delta's pre-delta rows back (exact rollback: restores
+        the bit pattern the rows had before apply_delta)."""
+        for name, cd in delta.coordinates.items():
+            self._scatter_coordinate(name, cd.rows, cd.prior)
+        self.delta_seq = delta.seq - 1
+        self.deltas_reverted += 1
 
     # -- request scoring ---------------------------------------------------
 

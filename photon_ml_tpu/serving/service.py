@@ -44,7 +44,14 @@ class ServingConfig:
 class ScoringService:
     def __init__(self, model_dir: Optional[str] = None,
                  model=None, config: Optional[ServingConfig] = None,
-                 emitter: Optional[EventEmitter] = None):
+                 emitter: Optional[EventEmitter] = None,
+                 updates=None, start_updater: bool = True):
+        """`updates` (an online.OnlineUpdateConfig) enables the online
+        learning tier: `feedback()` accepts labeled observations and a
+        background OnlineUpdater re-solves ONLY the touched entities'
+        random-effect subproblems, publishing row-level delta swaps into
+        the live scorer.  `start_updater=False` keeps the updater manual
+        (tests/bench drive `service.updater.run_once()` themselves)."""
         if (model_dir is None) == (model is None):
             raise ValueError("pass exactly one of model_dir / model")
         self.config = config or ServingConfig()
@@ -72,6 +79,14 @@ class ScoringService:
                           max_queue=cfg.max_queue),
             on_shed=self.metrics.observe_shed,
             on_deadline=self.metrics.observe_deadline)
+        self.updater = None
+        if updates is not None:
+            from photon_ml_tpu.online import OnlineUpdater
+            self.updater = OnlineUpdater(self.registry,
+                                         metrics=self.metrics,
+                                         config=updates, emitter=emitter)
+            if start_updater:
+                self.updater.start()
         self._closed = False
         # one telemetry.snapshot() returns serving state alongside the
         # training/streaming registries (latest-constructed service wins
@@ -133,6 +148,28 @@ class ScoringService:
                 model_version=scorer.version))
         return result
 
+    # -- online updates ----------------------------------------------------
+
+    def feedback(self, features: Dict[str, np.ndarray],
+                 ids: Dict[str, np.ndarray], labels: np.ndarray,
+                 weights=None, offsets=None, event_ids=None) -> Dict:
+        """Enqueue labeled feedback for the online tier: the touched
+        entities' random-effect rows re-solve in the background and
+        publish as delta swaps.  Raises Overloaded under backpressure;
+        RuntimeError when updates are not enabled."""
+        if self.updater is None:
+            raise RuntimeError(
+                "online updates are not enabled — construct the service "
+                "with updates=OnlineUpdateConfig() (or cli.serve "
+                "--enable-updates)")
+        return self.updater.submit(features, ids, labels, weights=weights,
+                                   offsets=offsets, event_ids=event_ids)
+
+    def version_vector(self) -> Dict:
+        """(full-model version, delta seq): the staleness identity of the
+        live scorer."""
+        return self.registry.version_vector()
+
     # -- model lifecycle ---------------------------------------------------
 
     def swap(self, model_dir: str, version: Optional[str] = None) -> str:
@@ -153,7 +190,13 @@ class ScoringService:
     # -- observability / lifecycle ----------------------------------------
 
     def metrics_snapshot(self) -> Dict:
-        return self.metrics.snapshot(model_version=self.registry.version)
+        snap = self.metrics.snapshot(model_version=self.registry.version)
+        snap["version_vector"] = self.registry.version_vector()
+        if self.updater is not None:
+            snap["online"]["pending_rows"] = self.updater.buffer.pending_rows
+            snap["online"]["frozen"] = len(self.updater.frozen_entities())
+            snap["online"]["pending_deltas"] = self.registry.pending_deltas()
+        return snap
 
     def prometheus_metrics(self) -> str:
         """Prometheus text exposition (the serving /metrics endpoint)."""
@@ -163,6 +206,8 @@ class ScoringService:
         if not self._closed:
             self._closed = True
             telemetry.unregister_collector("serving")
+            if self.updater is not None:
+                self.updater.close()
             self._batcher.close()
 
     def __enter__(self):

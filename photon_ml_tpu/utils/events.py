@@ -83,8 +83,22 @@ class ModelSwapEvent(Event):
     time: float
     version: str
     previous_version: Optional[str]
-    action: str = "swap"  # "swap" | "rollback"
+    action: str = "swap"  # "swap" | "rollback" | "delta_rollback"
     warmup_s: float = 0.0
+
+
+@dataclasses.dataclass
+class ModelDeltaEvent(Event):
+    """A row-level delta swap (serving/online): changed rows of the live
+    scorer's stacked random-effect tables were scattered in place under
+    the registry lock — no full-model cutover, no fresh XLA traces."""
+
+    time: float
+    version: str
+    delta_seq: int
+    coordinates: Dict[str, int]     # coordinate -> rows updated
+    num_rows: int
+    publish_s: float = 0.0
 
 
 class EventListener:

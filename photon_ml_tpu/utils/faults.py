@@ -55,6 +55,15 @@ Injection sites currently threaded (ctx keys in parentheses):
   solve.poison      after a coordinate solve       (coordinate, iteration)
                     — action "poison" corrupts the solve result with NaNs
                     instead of raising, exercising the quarantine path
+  online.solve      online updater micro-batch     (coordinate)
+                    solve (online/updater.py); transient faults retry with
+                    the staging backoff discipline, "poison" corrupts the
+                    solved rows with NaNs so the non-finite freeze path
+                    (entity quarantine, live table untouched) is exercised
+  online.publish    online delta publish into the  (coordinate)
+                    live scorer (registry.apply_delta call site);
+                    transient faults retry, fatal ones drop the delta and
+                    re-enqueue the feedback for the next cycle
 """
 from __future__ import annotations
 
@@ -86,6 +95,8 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "model.save": ("directory",),
     "model.load": ("directory",),
     "solve.poison": ("coordinate", "iteration"),
+    "online.solve": ("coordinate",),
+    "online.publish": ("coordinate",),
 }
 
 

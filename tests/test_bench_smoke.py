@@ -243,6 +243,52 @@ def test_trace_smoke(tmp_path):
     assert cli["run_log_records"] > 0
 
 
+def test_online_smoke(tmp_path):
+    """bench.py --online --smoke end-to-end in tier-1 (ISSUE 9 satellite):
+    the online-learning harness — feedback intake, anchored micro-batch
+    solves, delta swaps into the live scorer, offline-refit parity, the
+    steady-state compile gate, and delta-aware rollback — cannot rot
+    without failing the normal test run.  The scoring-p99-under-update
+    gate is a smoke SIGNAL here (shared-core CI); the full bench run
+    enforces it hard."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_online.json"
+    result = bench.online_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    # online-updated rows match the offline refit of the same entities
+    parity = next(e for e in detail["entries"]
+                  if e["name"] == "online_parity")
+    assert parity["parity_ok"] is True
+    assert parity["max_rel_gap_vs_offline_refit"] <= parity["parity_gate"]
+    assert max(parity["scipy_oracle_rel_gaps"]) <= 1e-4
+    assert parity["deltas"] >= 1
+    # warm serve loop absorbing deltas: zero fresh XLA traces
+    traces = next(e for e in detail["entries"]
+                  if e["name"] == "online_steady_state_traces")
+    assert traces["fresh_traces_steady_state"] == 0
+    assert traces["deltas_absorbed"] >= traces["steady_rounds"]
+    # delta-aware rollback round-trips bit-exact + durable persistence
+    rollback = next(e for e in detail["entries"]
+                    if e["name"] == "online_rollback")
+    assert rollback["rollback_bit_exact"] is True
+    assert rollback["delta_durable_roundtrip_ok"] is True
+    assert rollback["deltas_applied"] >= 3
+    # updates actually ran concurrent with scoring traffic
+    latency = next(e for e in detail["entries"]
+                   if e["name"] == "online_latency")
+    assert latency["under_updates"]["entities_updated"] > 0
+    assert latency["under_updates"]["deltas_published"] > 0
+    assert latency["baseline"]["errors"] == 0
+    assert latency["under_updates"]["errors"] == 0
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a
