@@ -36,6 +36,15 @@ _PRAGMA_RE = re.compile(
     r"#\s*photonlint:\s*(disable-file|disable|flush-point)"
     r"(?:\s*=\s*(PH[0-9]{3}(?:\s*,\s*PH[0-9]{3})*))?")
 
+#: guard declaration pragma (the concurrency pass, PH010/PH013):
+#: `self._table = {}  # photonlint: guarded-by=_lock` declares the
+#: attribute guarded by `self._lock`; `guarded-by=atomic` declares it
+#: deliberately lock-free (an atomic-publish attribute — e.g. a tuple
+#: swap read by scoring threads at batch granularity).
+_GUARD_RE = re.compile(
+    r"#\s*photonlint:\s*guarded-by\s*=\s*"
+    r"(atomic|none|(?:self\.)?[A-Za-z_][A-Za-z0-9_]*)")
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -47,6 +56,10 @@ class Finding:
     col: int
     message: str
     text: str          # stripped source line — the baseline identity
+    #: the evidence chain (PH010–PH013): how the guard was established,
+    #: witness call paths of a lock-order inversion, which thread root
+    #: makes the access concurrent.  Not part of the baseline identity.
+    evidence: Tuple[str, ...] = ()
 
     @property
     def baseline_path(self) -> str:
@@ -64,12 +77,18 @@ class Finding:
         return (self.rule, self.baseline_path, self.text)
 
     def to_dict(self) -> dict:
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "message": self.message, "text": self.text}
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "col": self.col, "message": self.message, "text": self.text}
+        if self.evidence:
+            out["evidence"] = list(self.evidence)
+        return out
 
     def render(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+        head = (f"{self.path}:{self.line}:{self.col}: {self.rule} "
                 f"{self.message}")
+        if self.evidence:
+            head += "".join(f"\n    | {e}" for e in self.evidence)
+        return head
 
 
 # -- suppression pragmas ------------------------------------------------------
@@ -83,7 +102,14 @@ class Suppressions:
         self.line_all: Set[int] = set()
         self.line_rules: Dict[int, Set[str]] = {}
         self.flush_lines: Set[int] = set()
+        self.guard_lines: Dict[int, str] = {}   # lineno -> declared lock
         for lineno, text in enumerate(lines, start=1):
+            g = _GUARD_RE.search(text)
+            if g:
+                name = g.group(1)
+                if name.startswith("self."):
+                    name = name[len("self."):]
+                self.guard_lines[lineno] = name
             m = _PRAGMA_RE.search(text)
             if not m:
                 continue
@@ -621,16 +647,52 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
+_RANGE_RE = re.compile(r"(PH[0-9]+)-(PH[0-9]+)$")
+
+
+def select_matcher(select: Optional[Sequence[str]]):
+    """Selector patterns -> predicate over rule ids.  A pattern is an
+    exact id (`PH005`), a prefix (`PH01` selects PH010–PH013), or an
+    inclusive range (`PH010-PH013`)."""
+    if select is None:
+        return lambda rule_id: True
+    prefixes: List[str] = []
+    ranges: List[Tuple[str, str]] = []
+    for pat in select:
+        m = _RANGE_RE.fullmatch(pat.strip())
+        if m:
+            ranges.append((m.group(1), m.group(2)))
+        elif pat.strip():
+            prefixes.append(pat.strip())
+
+    def match(rule_id: str) -> bool:
+        return (any(rule_id == p or rule_id.startswith(p)
+                    for p in prefixes)
+                or any(lo <= rule_id <= hi for lo, hi in ranges))
+
+    return match
+
+
 def lint_paths(paths: Sequence[str],
                select: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run every (selected) rule over every .py file under `paths`.
-    Suppressions are applied; the baseline is NOT (lint.py owns that)."""
+    Suppressions are applied; the baseline is NOT (lint.py owns that).
+
+    Per-module rules see one `ModuleContext` at a time; PROGRAM rules
+    (the concurrency pass, `rule.program_rule` True) run once over every
+    successfully parsed module so interprocedural facts — the call graph,
+    thread roots, the lock-acquisition-order graph — span the package."""
     from photon_ml_tpu.analysis.rules import all_rules
     files = iter_py_files(paths)
     registry, registry_path = load_sites_registry(files)
-    rules = [r for r in all_rules()
-             if select is None or r.rule_id in select]
+    matches = select_matcher(select)
+    rules = [r for r in all_rules() if matches(r.rule_id)]
+    module_rules = [r for r in rules
+                    if not getattr(r, "program_rule", False)]
+    program_rules = [r for r in rules
+                     if getattr(r, "program_rule", False)]
     findings: List[Finding] = []
+    contexts: List[ModuleContext] = []
     for path in files:
         display = os.path.relpath(path) if os.path.isabs(path) else path
         try:
@@ -645,9 +707,20 @@ def lint_paths(paths: Sequence[str],
             continue
         ctx.sites_registry = registry
         ctx.sites_registry_path = registry_path
-        for rule in rules:
+        contexts.append(ctx)
+        for rule in module_rules:
             for f in rule.check(ctx):
                 if not ctx.suppressions.suppressed(f.rule, f.line):
+                    findings.append(f)
+    if program_rules and contexts:
+        from photon_ml_tpu.analysis.concurrency import ProgramContext
+        program = ProgramContext(contexts)
+        by_path = {ctx.display_path: ctx for ctx in contexts}
+        for rule in program_rules:
+            for f in rule.check_program(program):
+                ctx = by_path.get(f.path)
+                if ctx is None or not ctx.suppressions.suppressed(f.rule,
+                                                                  f.line):
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

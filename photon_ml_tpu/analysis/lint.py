@@ -3,21 +3,34 @@
     python -m photon_ml_tpu.analysis.lint photon_ml_tpu/
     python -m photon_ml_tpu.analysis.lint --json path/ > findings.json
     python -m photon_ml_tpu.analysis.lint --write-baseline photon_ml_tpu/
+    python -m photon_ml_tpu.analysis.lint --select PH01            # prefix
+    python -m photon_ml_tpu.analysis.lint --select PH010-PH013     # range
+    python -m photon_ml_tpu.analysis.lint --diff                   # vs HEAD
+    python -m photon_ml_tpu.analysis.lint --diff origin/main
 
 Exit status: 0 = no findings beyond the committed baseline, 1 = new
 findings (CI-gateable), 2 = usage error.  `--json` emits a machine-
-readable report (findings + counts + baseline accounting) for CI
-annotation tooling.  The default baseline is the committed
-`photon_ml_tpu/analysis/baseline.json`; `--no-baseline` reports
-everything (how `--write-baseline` decides what to grandfather).
+readable report (findings + counts + baseline accounting; PH010–PH013
+findings carry their `evidence` chain — guard-inference source, witness
+call paths for inversions) for CI annotation tooling.  The default
+baseline is the committed `photon_ml_tpu/analysis/baseline.json`;
+`--no-baseline` reports everything (how `--write-baseline` decides what
+to grandfather).
+
+`--diff [REF]` is the fast pre-commit mode: the WHOLE package is still
+analyzed (the concurrency pass is interprocedural — a lock-order edge
+can span files you did not touch), but only findings anchored in files
+changed vs the git ref (default `HEAD`; staged, unstaged, and untracked
+files all count) are reported.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Set
 
 from photon_ml_tpu.analysis.engine import Baseline, Finding, lint_paths
 
@@ -44,11 +57,44 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings to --baseline and "
                         "exit 0 (grandfathering workflow)")
-    p.add_argument("--select", default=None, metavar="PH001,PH002",
-                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--select", default=None, metavar="PH001,PH01,PH010-PH013",
+                   help="comma-separated rule selectors: exact ids, "
+                        "prefixes (PH01 = PH010..PH013), or inclusive "
+                        "ranges (PH010-PH013); default: all rules")
+    p.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="report only findings in files changed vs the "
+                        "git ref (default HEAD when given bare); the "
+                        "whole tree is still analyzed so interprocedural "
+                        "rules see every edge")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
+
+
+def _git_changed_files(ref: str, paths: Sequence[str]) -> Set[str]:
+    """Absolute paths of .py files changed vs `ref` (committed diff +
+    working tree + untracked), resolved from the repo containing the
+    first lint path.  Raises RuntimeError when git cannot answer."""
+    anchor = os.path.abspath(paths[0])
+    if not os.path.isdir(anchor):
+        anchor = os.path.dirname(anchor)
+
+    def run(*args: str) -> str:
+        proc = subprocess.run(["git", "-C", anchor, *args],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return proc.stdout
+
+    top = run("rev-parse", "--show-toplevel").strip()
+    names = set(run("diff", "--name-only", ref).splitlines())
+    names |= set(run("ls-files", "--others",
+                     "--exclude-standard").splitlines())
+    return {os.path.abspath(os.path.join(top, n))
+            for n in names if n.endswith(".py")}
 
 
 def _list_rules() -> None:
@@ -67,6 +113,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     findings = lint_paths(paths, select=select)
+
+    if args.diff is not None:
+        try:
+            changed = _git_changed_files(args.diff, paths)
+        except RuntimeError as e:
+            print(f"photonlint: --diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+        findings = [f for f in findings
+                    if os.path.abspath(f.path) in changed]
 
     if args.write_baseline:
         n = Baseline.write(args.baseline, findings)

@@ -1,10 +1,16 @@
-"""photonlint rule catalog (PH001–PH007).
+"""photonlint rule catalog (PH001–PH013).
 
 Each rule is a class with an `rule_id`, a one-line `summary` (the `--list-
 rules` catalog), and `check(ctx) -> Iterable[Finding]` over an
 `engine.ModuleContext`.  Adding a rule = adding a class here and listing
 it in `all_rules()`; fixtures under tests/lint_fixtures/ demonstrate one
 violation and one compliant near-miss per rule.
+
+PH010–PH013 are PROGRAM rules (`program_rule = True`,
+`check_program(ProgramContext)`): the concurrency pass in
+`analysis/concurrency.py` needs the whole package at once — a call graph,
+thread roots, and the lock-acquisition-order graph are interprocedural by
+nature.  `engine.lint_paths` runs them after the per-module rules.
 
 Precision over recall: every check is anchored to the module semantics the
 engine resolved (import aliases, wrapper forms, device-value tracking), so
@@ -580,6 +586,7 @@ class RawTimerRule(Rule):
 
 
 def all_rules() -> List[Rule]:
+    from photon_ml_tpu.analysis.concurrency import concurrency_rules
     return [HostSyncRule(), RetraceHazardRule(), DonationSafetyRule(),
             FaultSiteRule(), DurableWriteRule(), NondeterminismRule(),
-            RawTimerRule()]
+            RawTimerRule()] + concurrency_rules()

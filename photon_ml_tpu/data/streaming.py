@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, locktrace
 from photon_ml_tpu.utils.math import ceil_pow2
 
 # never plan chunks smaller than this: per-chunk dispatch overhead would
@@ -176,7 +176,8 @@ class StreamStats:
     it never exceeds the Prefetcher depth."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "StreamStats._lock")
         self.total_bytes = 0
         self.chunks_staged = 0
         self.passes = 0

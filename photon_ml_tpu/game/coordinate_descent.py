@@ -429,8 +429,11 @@ class AsyncCheckpointer:
     def __init__(self, directory: str):
         import threading
 
+        from photon_ml_tpu.utils import locktrace
+
         self.directory = directory
-        self._cv = threading.Condition()
+        self._cv = locktrace.tracked(threading.Condition(),
+                                     "AsyncCheckpointer._cv")
         self._pending: Optional[tuple] = None
         self._busy = False
         self._closed = False

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from photon_ml_tpu.serving.batcher import Overloaded
+from photon_ml_tpu.utils import locktrace
 
 
 @dataclasses.dataclass
@@ -69,7 +70,8 @@ class FeedbackBuffer:
         self.max_rows = int(max_rows)
         self.entity_window = int(entity_window)
         self.dedup_window = int(dedup_window)
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "FeedbackBuffer._lock")
         # lane -> OrderedDict[entity_id -> (row, deque[Observation])];
         # OrderedDict insertion order IS the FIFO drain order
         self._lanes: Dict[str, "OrderedDict[object, Tuple[int, deque]]"] = {}

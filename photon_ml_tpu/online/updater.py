@@ -53,7 +53,7 @@ from photon_ml_tpu.ops import losses as L
 from photon_ml_tpu.optim import OptimizerConfig
 from photon_ml_tpu.parallel.random_effect import EntityBlocks
 from photon_ml_tpu.serving.registry import StaleDeltaError
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, locktrace
 from photon_ml_tpu.utils.math import ceil_pow2
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -114,16 +114,22 @@ class OnlineUpdater:
                                      dedup_window=config.dedup_window)
         self._solver = OptimizerConfig(max_iterations=config.max_iterations,
                                        tolerance=config.tolerance)
-        self._frozen: set = set()           # (lane, entity_id)
+        # mutable updater state crosses three threads (request intake, the
+        # background loop, operator introspection): everything below is
+        # guarded by _state_lock — photonlint PH010/PH013 enforce it, and
+        # the armed locktrace tracker observes it in the stress test
+        self._state_lock = locktrace.tracked(threading.Lock(),
+                                             "OnlineUpdater._state_lock")
+        self._frozen: set = set()    # (lane, entity_id)  # photonlint: guarded-by=_state_lock
+        self._thread: Optional[threading.Thread] = None   # photonlint: guarded-by=_state_lock
+        self.cycles = 0                                   # photonlint: guarded-by=_state_lock
+        self.deltas_published = 0                         # photonlint: guarded-by=_state_lock
+        self.last_error: Optional[str] = None             # photonlint: guarded-by=_state_lock
         self._wake = threading.Event()
         self._closed = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._jitter = random.Random(0xC0FFEE)
-        self.cycles = 0
-        self.deltas_published = 0
         self.warmed = False
         self.warmup_s = 0.0
-        self.last_error: Optional[str] = None
 
     # -- intake -------------------------------------------------------------
 
@@ -158,6 +164,10 @@ class OnlineUpdater:
         entries: List[Tuple[str, object, int, Observation]] = []
         unseen = frozen = 0
         lane_meta = scorer.updatable_coordinates()
+        # one coherent snapshot of the quarantine set for the whole batch
+        # (the updater thread freezes entities concurrently) [PH010]
+        with self._state_lock:
+            frozen_now = set(self._frozen)
         for i in range(n):
             obs = Observation(
                 features={s: feats[s][i] for s in feats},
@@ -171,7 +181,7 @@ class OnlineUpdater:
                 if row < 0:
                     unseen += 1
                     continue
-                if (lane, entity_id) in self._frozen:
+                if (lane, entity_id) in frozen_now:
                     frozen += 1
                     continue
                 entries.append((lane, entity_id, row, obs))
@@ -363,6 +373,12 @@ class OnlineUpdater:
                 time.sleep(cfg.backoff_s * (2 ** (attempt - 1))
                            * (1.0 + 0.25 * self._jitter.random()))
 
+    def _note_error(self, exc: BaseException) -> str:
+        msg = f"{type(exc).__name__}: {exc}"
+        with self._state_lock:
+            self.last_error = msg
+        return msg
+
     def _loss(self):
         task = self.registry.scorer.model.task_type
         loss = L.TASK_LOSSES.get(task)
@@ -388,13 +404,12 @@ class OnlineUpdater:
         except BaseException as e:
             # a fatal solve failure drops the micro-batch: re-enqueueing
             # would retry a deterministic failure forever
-            self.last_error = f"{type(e).__name__}: {e}"
+            msg = self._note_error(e)
             if self.metrics is not None:
                 self.metrics.observe_solve_failure()
             telemetry.event("online_solve_failed", coordinate=lane,
-                            error=self.last_error)
-            logger.warning("online solve failed for %r: %s", lane,
-                           self.last_error)
+                            error=msg)
+            logger.warning("online solve failed for %r: %s", lane, msg)
             return None
         if self.metrics is not None:
             self.metrics.observe_update_cycle(entities=len(drained),
@@ -406,7 +421,8 @@ class OnlineUpdater:
             if not finite[e]:
                 # quarantine: the non-finite row NEVER reaches the live
                 # table; the entity freezes until an operator full-refit
-                self._frozen.add((lane, ef.entity_id))
+                with self._state_lock:
+                    self._frozen.add((lane, ef.entity_id))
                 self.buffer.drop_entity(lane, ef.entity_id)
                 if self.metrics is not None:
                     self.metrics.observe_frozen_entity()
@@ -445,19 +461,20 @@ class OnlineUpdater:
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:
-            self.last_error = f"{type(e).__name__}: {e}"
+            msg = self._note_error(e)
             if self.metrics is not None:
                 self.metrics.observe_solve_failure()
             telemetry.event("online_publish_failed", coordinate=lane,
-                            error=self.last_error)
+                            error=msg)
             logger.warning("online publish failed for %r: %s (feedback "
-                           "re-enqueued)", lane, self.last_error)
+                           "re-enqueued)", lane, msg)
             self.buffer.requeue(lane, drained)
             return None
         if self.metrics is not None:
             for lat in latencies:
                 self.metrics.observe_feedback_to_publish(lat)
-        self.deltas_published += 1
+        with self._state_lock:
+            self.deltas_published += 1
         return {"entities": len(keep_rows), "rows": num_rows}
 
     def _publish_with_retry(self, lane: str, delta: ModelDelta,
@@ -485,33 +502,39 @@ class OnlineUpdater:
     # -- introspection ------------------------------------------------------
 
     def frozen_entities(self) -> List[Tuple[str, object]]:
-        return sorted(self._frozen, key=str)
+        with self._state_lock:
+            return sorted(self._frozen, key=str)
 
     def stats(self) -> Dict[str, object]:
-        return {"cycles": self.cycles,
-                "deltas_published": self.deltas_published,
-                "frozen": len(self._frozen),
-                "buffer": self.buffer.stats(),
-                "last_error": self.last_error}
+        buffer_stats = self.buffer.stats()   # buffer takes its own lock
+        with self._state_lock:
+            return {"cycles": self.cycles,
+                    "deltas_published": self.deltas_published,
+                    "frozen": len(self._frozen),
+                    "buffer": buffer_stats,
+                    "last_error": self.last_error}
 
     # -- background loop ----------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._closed.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="photon-online-updater")
-        self._thread.start()
+        # test and spawn under the lock: two racing start() calls must
+        # not each launch a loop thread [PH013 check-then-act]
+        with self._state_lock:
+            if self._thread is not None:
+                return
+            self._closed.clear()
+            thread = threading.Thread(target=self._loop, daemon=True,
+                                      name="photon-online-updater")
+            self._thread = thread
+        thread.start()
 
     def _loop(self) -> None:
         try:
             if not self.warmed:
                 self.warmup()
         except Exception as e:  # a failed warmup must not kill the loop
-            self.last_error = f"{type(e).__name__}: {e}"
             logger.exception("online updater warmup failed: %s",
-                             self.last_error)
+                             self._note_error(e))
         while not self._closed.is_set():
             self._wake.wait(timeout=self.config.interval_s)
             self._wake.clear()
@@ -519,20 +542,24 @@ class OnlineUpdater:
                 break
             try:
                 while self.buffer.lanes() and not self._closed.is_set():
-                    self.cycles += 1
+                    with self._state_lock:
+                        self.cycles += 1
                     out = self.run_once()
                     if out["deltas"] == 0 and out["entities"] == 0:
                         break  # nothing publishable; wait for fresh rows
             except Exception as e:  # the loop must never die silently
-                self.last_error = f"{type(e).__name__}: {e}"
                 logger.exception("online update cycle failed: %s",
-                                 self.last_error)
+                                 self._note_error(e))
                 if self.metrics is not None:
                     self.metrics.observe_solve_failure()
 
     def close(self, timeout: float = 5.0) -> None:
         self._closed.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
-            self._thread = None
+        # detach under the lock, join OUTSIDE it: the loop thread takes
+        # _state_lock (cycle counters, freezes), so joining while holding
+        # it would deadlock — exactly what PH012 flags
+        with self._state_lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=timeout)

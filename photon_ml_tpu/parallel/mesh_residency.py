@@ -48,7 +48,7 @@ from photon_ml_tpu import telemetry
 from photon_ml_tpu.parallel.mesh import (
     DATA_AXIS, data_sharding, feature_sharding, replicated,
 )
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, locktrace
 
 # staging retry policy — mirrors data/streaming.py's Prefetcher: a flaky
 # host read / device transfer must not kill a long fit; transient failures
@@ -73,7 +73,8 @@ class TransferStats:
     update.  Thread-safe: scoring may stage from worker threads."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "TransferStats._lock")
         self.cold_bytes = 0
         self.warm_bytes = 0
         self.cold_stages = 0
@@ -208,7 +209,8 @@ class MeshResidency:
         self.max_entries = max_entries
         self.stats = TransferStats()
         self._entries: "collections.OrderedDict" = collections.OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "MeshResidency._lock")
         self._jitter = random.Random(0)
 
     # -- staging --------------------------------------------------------------
@@ -327,12 +329,18 @@ class MeshResidency:
 # descent loop, benches, and the CLI summary all read one TransferStats.
 
 _DEFAULT: Optional[MeshResidency] = None
+_DEFAULT_LOCK = threading.Lock()
 
 
 def default_residency() -> MeshResidency:
+    # double-checked: scoring worker threads and the training loop race
+    # the first stage; a bare check-then-act would build TWO registries
+    # and split the TransferStats the mesh bench gates on [PH013]
     global _DEFAULT
     if _DEFAULT is None:
-        _DEFAULT = MeshResidency()
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = MeshResidency()
     return _DEFAULT
 
 

@@ -26,6 +26,8 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from photon_ml_tpu.utils import locktrace
+
 
 class ServingError(RuntimeError):
     """Base class for explicit serving failures."""
@@ -84,7 +86,8 @@ class MicroBatcher:
             raise ValueError("max_batch and max_queue must be >= 1")
         self._on_shed = on_shed
         self._on_deadline = on_deadline
-        self._cv = threading.Condition()
+        self._cv = locktrace.tracked(threading.Condition(),
+                                     "MicroBatcher._cv")
         self._queue: collections.deque = collections.deque()
         self._open = True
         self._worker = threading.Thread(target=self._loop, daemon=True,
@@ -103,14 +106,20 @@ class MicroBatcher:
         with self._cv:
             if not self._open:
                 raise ServingError("batcher is closed")
-            if len(self._queue) >= self.config.max_queue:
-                if self._on_shed is not None:
-                    self._on_shed()
-                raise Overloaded(
-                    f"request queue at capacity ({self.config.max_queue} "
-                    "pending requests)")
-            self._queue.append(req)
-            self._cv.notify()
+            shed = len(self._queue) >= self.config.max_queue
+            if not shed:
+                self._queue.append(req)
+                self._cv.notify()
+        if shed:
+            # the shed callback runs OUTSIDE the condition: it is
+            # arbitrary metrics/listener code, and invoking it under the
+            # batcher lock would nest foreign locks inside _cv (a
+            # photonlint PH011/PH012 hazard on the hottest serving path)
+            if self._on_shed is not None:
+                self._on_shed()
+            raise Overloaded(
+                f"request queue at capacity ({self.config.max_queue} "
+                "pending requests)")
         # the worker ALWAYS sets the event (scored, errored, expired, or
         # closed), so an un-set event after deadline + grace means only
         # that the device call itself is still running — keep waiting in

@@ -31,6 +31,7 @@ from typing import Dict, Optional
 
 from photon_ml_tpu.telemetry.export import prometheus_text
 from photon_ml_tpu.telemetry.metrics import MetricsRegistry
+from photon_ml_tpu.utils import locktrace
 
 
 class ServingMetrics:
@@ -39,7 +40,8 @@ class ServingMetrics:
 
     def __init__(self, latency_window: int = 8192,
                  registry: Optional[MetricsRegistry] = None):
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "ServingMetrics._lock")
         self._t0 = time.monotonic()
         self.registry = registry if registry is not None else MetricsRegistry()
         r = self.registry

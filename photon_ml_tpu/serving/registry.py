@@ -30,7 +30,7 @@ from concurrent.futures import Future
 from typing import Callable, Optional, Tuple
 
 from photon_ml_tpu.serving.scorer import CompiledScorer
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, locktrace
 from photon_ml_tpu.utils.events import (EventEmitter, ModelDeltaEvent,
                                         ModelSwapEvent)
 
@@ -59,7 +59,8 @@ class ModelRegistry:
             lambda d, v: CompiledScorer.from_model_dir(d, version=v))
         self._emitter = emitter
         self._metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "ModelRegistry._lock")
         self._counter = 0
         self._current: Optional[Tuple[str, CompiledScorer]] = None
         self._previous: Optional[Tuple[str, CompiledScorer]] = None

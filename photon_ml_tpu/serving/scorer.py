@@ -160,7 +160,10 @@ class CompiledScorer:
                 raise TypeError(f"unknown coordinate model type {type(m)}")
         if not tables:
             raise ValueError("model has no coordinates to serve")
-        self._tables = tuple(tables)
+        # deliberately lock-free: delta publishers replace the WHOLE tuple
+        # (never mutate in place) and scoring threads read it once per
+        # batch — atomic publish at batch granularity
+        self._tables = tuple(tables)  # photonlint: guarded-by=atomic
         self.feature_shards: Dict[str, int] = shard_dims
         self.entity_types = sorted(
             {t for _, _, t in self._re_meta}

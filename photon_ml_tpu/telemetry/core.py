@@ -313,11 +313,14 @@ class Tracer:
     # -- run log -----------------------------------------------------------
 
     def _log_record(self, record: dict) -> None:
-        f = self._run_log
-        if f is None:
-            return
         line = json.dumps(record, separators=(",", ":"))
+        # the handle is read AND written under the lock: finish() swaps it
+        # to None concurrently with producer threads logging (photonlint
+        # PH010 — _run_log is guarded by _lock)
         with self._lock:
+            f = self._run_log
+            if f is None:
+                return
             try:
                 f.write(line + "\n")
             except ValueError:  # closed mid-shutdown race: drop, not crash

@@ -32,6 +32,8 @@ import collections
 import threading
 from typing import Dict, Optional
 
+from photon_ml_tpu.utils import locktrace
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "counter", "gauge", "histogram"]
 
@@ -43,7 +45,7 @@ class Counter:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(), "Counter._lock")
         self._value = 0
 
     def inc(self, amount=1) -> None:
@@ -67,7 +69,7 @@ class Gauge:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(), "Gauge._lock")
         self._value = 0.0
 
     def set(self, value) -> None:
@@ -100,7 +102,7 @@ class Histogram:
             raise ValueError(f"histogram {name!r}: reservoir must be >= 1, "
                              f"got {reservoir}")
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(), "Histogram._lock")
         self._ring = collections.deque(maxlen=int(reservoir))
         self.count = 0
         self.sum = 0.0
@@ -166,7 +168,8 @@ class MetricsRegistry:
     a counter silently shadowing a gauge would corrupt both)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "MetricsRegistry._lock")
         self._instruments: Dict[str, object] = {}
 
     def _get(self, name: str, cls, *args):

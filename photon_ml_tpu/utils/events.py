@@ -123,7 +123,9 @@ class EventEmitter:
     listener exceptions contained."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from photon_ml_tpu.utils import locktrace
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "EventEmitter._lock")
         self._listeners: List[EventListener] = []
 
     def register_listener(self, listener: EventListener) -> None:
@@ -155,13 +157,16 @@ class EventEmitter:
         self.register_listener(cls())
 
     def clear_listeners(self) -> None:
+        # swap the list under the lock, close OUTSIDE it: listener close
+        # hooks are arbitrary consumer code, and running them while
+        # holding the emitter lock would nest foreign locks inside it
         with self._lock:
-            for listener in self._listeners:
-                try:
-                    listener.close()
-                except Exception:
-                    _log.exception("event listener close failed")
-            self._listeners = []
+            doomed, self._listeners = self._listeners, []
+        for listener in doomed:
+            try:
+                listener.close()
+            except Exception:
+                _log.exception("event listener close failed")
 
     def send_event(self, event: Event) -> None:
         _route_to_telemetry(event)

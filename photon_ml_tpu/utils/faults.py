@@ -215,7 +215,9 @@ class FaultPlan:
                     f"{list(SITES[s.site])} (see utils.faults.SITES)")
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        from photon_ml_tpu.utils import locktrace
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "FaultPlan._lock")
 
     # -- JSON round-trip (PHOTON_FAULT_PLAN / --fault-plan) ----------------
     @staticmethod
@@ -227,8 +229,12 @@ class FaultPlan:
         return FaultPlan.from_dict(json.loads(text))
 
     def to_dict(self) -> dict:
+        # snapshot under the lock: specs fire (and count) from staging and
+        # training threads concurrently with plan serialization [PH010]
+        with self._lock:
+            specs = list(self.specs)
         return {"seed": self.seed,
-                "faults": [s.to_dict() for s in self.specs]}
+                "faults": [s.to_dict() for s in specs]}
 
     def report(self) -> dict:
         """Per-site calls/fired accounting (the bench records this per

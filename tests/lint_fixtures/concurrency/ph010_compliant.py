@@ -1,0 +1,32 @@
+"""PH010 near-miss: every guarded access holds the lock; the lock-free
+snapshot tuple is explicitly declared `guarded-by=atomic` (the tuple-swap
+publish idiom), so its cross-thread reads are sanctioned."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._level = 0      # photonlint: guarded-by=_lock
+        self._snapshot = ()  # photonlint: guarded-by=atomic
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._level += 1
+                level = self._level
+            self._snapshot = (level,)
+
+    def read(self):
+        with self._lock:
+            return self._level
+
+    def last(self):
+        return self._snapshot
+
+    def drain(self):
+        with self._lock:
+            self._level = 0

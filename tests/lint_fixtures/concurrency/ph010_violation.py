@@ -1,0 +1,43 @@
+"""PH010 fixture: unguarded access to guarded attributes.
+
+`_level` is DECLARED guarded; `_total` is INFERRED guarded (3 of its 4
+accesses hold the lock).  The stray read and writes outside the lock are
+the violations (3 findings)."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._level = 0      # photonlint: guarded-by=_lock
+        self._total = 0
+        self._flow = 0.0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._level += 1
+                self._total += 1
+                self._flow = self._flow + 1.0
+
+    def read(self):
+        return self._level          # violation: declared guard, no lock
+
+    def drain(self):
+        self._level = 0             # violation: write outside the lock
+
+    def totals(self):
+        with self._lock:
+            a = self._total
+            b = self._total
+        return a + b
+
+    def skim(self):
+        self._total -= 1            # violation: inferred guard, no lock
+
+    def flow(self):
+        with self._lock:
+            return self._flow

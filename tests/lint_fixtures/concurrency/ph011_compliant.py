@@ -1,0 +1,24 @@
+"""PH011 near-miss: both paths honor one global order (alpha before
+beta), including through a helper call — no cycle."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+        self.credits = 0
+        self.debits = 0
+
+    def credit(self):
+        with self._alpha:
+            with self._beta:
+                self.credits += 1
+
+    def debit(self):
+        with self._alpha:
+            self._locked_debit()
+
+    def _locked_debit(self):
+        with self._beta:
+            self.debits += 1

@@ -1,0 +1,21 @@
+"""PH011 fixture: two locks nested in opposite orders on two paths — a
+cycle in the acquisition-order graph (1 finding, both witnesses)."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+        self.credits = 0
+        self.debits = 0
+
+    def credit(self):
+        with self._alpha:
+            with self._beta:
+                self.credits += 1
+
+    def debit(self):
+        with self._beta:
+            with self._alpha:
+                self.debits += 1

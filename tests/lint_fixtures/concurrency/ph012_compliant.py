@@ -1,0 +1,29 @@
+"""PH012 near-miss: the blocking work happens OUTSIDE the critical
+section and only the reference swap runs under the lock; a condition
+variable waiting on ITSELF is the sanctioned idiom, not a stall."""
+import threading
+import time
+
+import jax
+
+
+class Swapper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._table = None
+        self._busy = False
+
+    def publish(self, x):
+        fetched = jax.device_get(x)      # blocking work before the lock
+        jax.block_until_ready(x)
+        with self._lock:
+            self._table = fetched        # only the swap is locked
+
+    def throttle(self):
+        time.sleep(0.01)                 # no lock held
+
+    def drain(self):
+        with self._cv:
+            while self._busy:
+                self._cv.wait(0.1)       # waits on the HELD cv: exempt

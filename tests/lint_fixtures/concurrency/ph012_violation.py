@@ -1,0 +1,21 @@
+"""PH012 fixture: blocking calls inside a lock region (3 findings) — a
+device fetch, a host-side block-until-ready, and a sleep all stall every
+thread contending for the lock."""
+import threading
+import time
+
+import jax
+
+
+class Swapper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = None
+        self._done = threading.Event()
+
+    def publish(self, x):
+        with self._lock:
+            fetched = jax.device_get(x)       # violation: device sync
+            jax.block_until_ready(x)          # violation: blocks on device
+            time.sleep(0.01)                  # violation: sleeps
+            self._table = fetched

@@ -1,0 +1,38 @@
+"""PH013 near-miss: the locked-recheck (double-checked) lazy init, a
+guarded publish, and an early-exit check-then-act held under the lock."""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = None
+        self.generation = 0   # photonlint: guarded-by=_lock
+        self._thread = None
+
+    def start(self):
+        with self._lock:
+            if self._thread is not None:
+                return
+            thread = threading.Thread(target=self._refresh, daemon=True)
+            self._thread = thread
+        thread.start()
+
+    def table(self):
+        if self._table is None:
+            with self._lock:
+                if self._table is None:
+                    self._table = self._build()
+        return self._table
+
+    def _build(self):
+        return {}
+
+    def _refresh(self):
+        while True:
+            with self._lock:
+                self.generation += 1
+
+    def age(self):
+        with self._lock:
+            return self.generation

@@ -1,0 +1,28 @@
+"""PH013 fixture: a bare check-then-act lazy init, and an attribute
+published from the spawned thread with no lock (2 findings)."""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = None
+        self.generation = 0
+
+    def start(self):
+        threading.Thread(target=self._refresh, daemon=True).start()
+
+    def table(self):
+        if self._table is None:           # violation: two threads can
+            self._table = self._build()   # both pass and double-build
+        return self._table
+
+    def _build(self):
+        return {}
+
+    def _refresh(self):
+        while True:
+            self.generation += 1          # violation: unguarded publish
+
+    def age(self):
+        return self.generation

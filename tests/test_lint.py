@@ -34,6 +34,14 @@ CASES = [
     ("PH006", "ph006_violation.py", "ph006_compliant.py", 2),
     ("PH007", "hot/ops/ph007_violation.py",
      "hot/ops/ph007_compliant.py", 4),
+    ("PH010", "concurrency/ph010_violation.py",
+     "concurrency/ph010_compliant.py", 3),
+    ("PH011", "concurrency/ph011_violation.py",
+     "concurrency/ph011_compliant.py", 1),
+    ("PH012", "concurrency/ph012_violation.py",
+     "concurrency/ph012_compliant.py", 3),
+    ("PH013", "concurrency/ph013_violation.py",
+     "concurrency/ph013_compliant.py", 2),
 ]
 
 
@@ -105,6 +113,124 @@ def test_ph005_is_durable_module_scoped(tmp_path):
 def test_select_filters_rules():
     findings = _lint("hot/ops/ph001_violation.py", select=["PH005"])
     assert findings == []
+
+
+def test_select_prefix_and_range():
+    # prefix: PH01 selects exactly the concurrency family
+    findings = _lint("concurrency/ph010_violation.py", select=["PH01"])
+    assert [f.rule for f in findings] == ["PH010"] * 3
+    assert _lint("hot/ops/ph001_violation.py", select=["PH01"]) == []
+    # inclusive range
+    findings = _lint("concurrency/ph012_violation.py",
+                     select=["PH010-PH013"])
+    assert [f.rule for f in findings] == ["PH012"] * 3
+    assert _lint("concurrency/ph012_violation.py",
+                 select=["PH010-PH011"]) == []
+
+
+# --------------------------------------------------------------------------
+# concurrency pass (PH010–PH013) semantics
+# --------------------------------------------------------------------------
+
+def test_guarded_by_annotation_round_trip(tmp_path):
+    # the declared guard drives the finding; adding the lock silences it
+    src = ("import threading\n"
+           "class Box:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._v: int = 0   # photonlint: guarded-by=_lock\n"
+           "    def bump(self):\n"
+           "        with self._lock:\n"
+           "            self._v += 1\n"
+           "    def peek(self):\n"
+           "        return self._v\n")
+    bad = tmp_path / "box.py"
+    bad.write_text(src)
+    findings = lint_paths([str(bad)])
+    assert [f.rule for f in findings] == ["PH010"]
+    assert "declared guarded-by=_lock" in " ".join(findings[0].evidence)
+    good = tmp_path / "box_ok.py"
+    good.write_text(src.replace(
+        "    def peek(self):\n        return self._v\n",
+        "    def peek(self):\n        with self._lock:\n"
+        "            return self._v\n"))
+    assert lint_paths([str(good)]) == []
+
+
+def test_guarded_by_unknown_lock_is_loud(tmp_path):
+    (tmp_path / "box.py").write_text(
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._v = 0   # photonlint: guarded-by=_mutex\n")
+    findings = lint_paths([str(tmp_path / "box.py")])
+    assert [f.rule for f in findings] == ["PH010"]
+    assert "names no lock attribute" in findings[0].message
+
+
+def test_ph011_reports_both_witness_paths():
+    findings = _lint("concurrency/ph011_violation.py", select=["PH011"])
+    assert len(findings) == 1
+    evidence = "\n".join(findings[0].evidence)
+    assert "witness Ledger._alpha -> Ledger._beta" in evidence
+    assert "witness Ledger._beta -> Ledger._alpha" in evidence
+    assert "Ledger.credit" in evidence and "Ledger.debit" in evidence
+
+
+def test_ph011_interprocedural_inversion(tmp_path):
+    # the reverse arc only exists through a helper call chain
+    (tmp_path / "ledger.py").write_text(
+        "import threading\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self._alpha = threading.Lock()\n"
+        "        self._beta = threading.Lock()\n"
+        "    def credit(self):\n"
+        "        with self._alpha:\n"
+        "            self._under_alpha()\n"
+        "    def _under_alpha(self):\n"
+        "        with self._beta:\n"
+        "            pass\n"
+        "    def debit(self):\n"
+        "        with self._beta:\n"
+        "            with self._alpha:\n"
+        "                pass\n")
+    findings = lint_paths([str(tmp_path / "ledger.py")])
+    assert [f.rule for f in findings] == ["PH011"]
+    assert "_under_alpha" in "\n".join(findings[0].evidence)
+
+
+def test_ph012_flush_style_suppression(tmp_path):
+    # the documented escape hatch: `# photonlint: disable=PH012` on the
+    # blocking line (for a measured, accepted stall)
+    src = open(os.path.join(FIXTURES, "concurrency/ph012_violation.py"),
+               encoding="utf-8").read()
+    src = src.replace("time.sleep(0.01)",
+                      "time.sleep(0.01)  # photonlint: disable=PH012")
+    dest = tmp_path / "swapper.py"
+    dest.write_text(src)
+    findings = lint_paths([str(dest)], select=["PH012"])
+    assert len(findings) == 2  # the two device blocks remain
+    assert all("time.sleep" not in f.text for f in findings)
+
+
+def test_ph013_locked_recheck_is_compliant():
+    assert _lint("concurrency/ph013_compliant.py") == []
+
+
+def test_evidence_lands_in_json_report():
+    findings = _lint("concurrency/ph011_violation.py")
+    d = findings[0].to_dict()
+    assert isinstance(d["evidence"], list) and len(d["evidence"]) >= 2
+
+
+def test_lock_order_edges_exports_static_graph():
+    from photon_ml_tpu.analysis.concurrency import lock_order_edges
+    edges = lock_order_edges(
+        [os.path.join(FIXTURES, "concurrency/ph011_violation.py")])
+    assert ("Ledger._alpha", "Ledger._beta") in edges
+    assert ("Ledger._beta", "Ledger._alpha") in edges
 
 
 def test_ph004_registry_docs_drift(tmp_path):
@@ -193,8 +319,85 @@ def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule_id in ("PH001", "PH002", "PH003", "PH004", "PH005", "PH006",
-                    "PH007"):
+                    "PH007", "PH010", "PH011", "PH012", "PH013"):
         assert rule_id in out
+
+
+def test_cli_select_concurrency_gate():
+    # the CI gate spelling: the whole package must be clean under
+    # `--select PH01` with the (empty) committed baseline
+    proc = _run_cli("--select", "PH01", "photon_ml_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # and the evidence chain reaches the JSON report
+    bad = _run_cli("tests/lint_fixtures/concurrency/ph011_violation.py",
+                   "--no-baseline", "--select", "PH010-PH013", "--json")
+    assert bad.returncode == 1
+    report = json.loads(bad.stdout)
+    assert report["counts"]["new"] == 1
+    assert report["findings"][0]["rule"] == "PH011"
+    assert any("witness" in e for e in report["findings"][0]["evidence"])
+
+
+def test_cli_diff_mode_filters_to_changed_files(tmp_path):
+    import textwrap
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(repo), *args], check=True,
+                       capture_output=True,
+                       env=dict(os.environ,
+                                GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                                GIT_COMMITTER_NAME="t",
+                                GIT_COMMITTER_EMAIL="t@t"))
+
+    violating = textwrap.dedent("""\
+        import threading
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0   # photonlint: guarded-by=_lock
+            def peek(self):
+                return self._v
+    """)
+    clean = "def nothing():\n    return 1\n"
+    (repo / "old.py").write_text(violating)   # committed violation
+    (repo / "new.py").write_text(clean)
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    # change only new.py to a violation; old.py stays dirty-but-committed
+    (repo / "new.py").write_text(violating)
+    full = lint_paths([str(repo)])
+    assert {os.path.basename(f.path) for f in full} == {"old.py", "new.py"}
+    rc = lint_main([str(repo), "--diff", "HEAD", "--no-baseline"])
+    assert rc == 1
+    # --diff vs HEAD must only report new.py (capture via --json)
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.analysis.lint", str(repo),
+         "--diff", "HEAD", "--no-baseline", "--json"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    report = json.loads(proc.stdout)
+    assert {os.path.basename(f["path"]) for f in report["findings"]} \
+        == {"new.py"}
+    # an untracked file counts as changed
+    (repo / "fresh.py").write_text(violating)
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.analysis.lint", str(repo),
+         "--diff", "HEAD", "--no-baseline", "--json"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    report = json.loads(proc.stdout)
+    assert {os.path.basename(f["path"]) for f in report["findings"]} \
+        == {"new.py", "fresh.py"}
+
+
+def test_cli_diff_outside_git_is_usage_error(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    proc = _run_cli(str(tmp_path), "--diff", "HEAD")
+    assert proc.returncode == 2
+    assert "--diff" in proc.stderr
 
 
 # --------------------------------------------------------------------------
@@ -213,6 +416,14 @@ def test_baseline_stays_small():
     # acceptance: <= 5 grandfathered findings, and it should only shrink
     baseline = Baseline.load(DEFAULT_BASELINE)
     assert baseline.total <= 5
+
+
+def test_concurrency_rules_are_never_grandfathered():
+    # ISSUE 10 acceptance: PH010–PH013 ship with an EMPTY baseline —
+    # concurrency findings get FIXED, not grandfathered
+    with open(DEFAULT_BASELINE, encoding="utf-8") as f:
+        entries = json.load(f).get("findings", [])
+    assert [e for e in entries if e["rule"].startswith("PH01")] == []
 
 
 def test_linter_package_lints_itself_clean():

@@ -1,0 +1,132 @@
+"""utils/locktrace.py: the runtime lock-order tracker.
+
+Covers the disarm contract (tracked() returns the RAW lock — zero
+wrapper overhead on every acquisition), armed edge recording with
+first-witness stacks, condition-variable semantics, and the
+static-vs-dynamic cross-validation both ways (consistent set passes,
+reversed/unknown orders fail loudly)."""
+import threading
+
+import pytest
+
+from photon_ml_tpu.utils import locktrace
+
+
+def test_disarmed_tracked_is_identity():
+    lock = threading.Lock()
+    assert locktrace.tracked(lock, "X._lock") is lock
+    cv = threading.Condition()
+    assert locktrace.tracked(cv, "X._cv") is cv
+    assert locktrace.active() is None
+
+
+def test_armed_wrapping_and_edge_recording():
+    with locktrace.enabled() as tracker:
+        a = locktrace.tracked(threading.Lock(), "A._lock")
+        b = locktrace.tracked(threading.Lock(), "B._lock")
+        assert isinstance(a, locktrace.TracedLock)
+        with a:
+            with b:
+                pass
+        with b:
+            pass  # no a under b: no reverse edge
+    assert locktrace.active() is None
+    edges = tracker.edges()
+    assert ("A._lock", "B._lock") in edges
+    assert ("B._lock", "A._lock") not in edges
+    thread, stack = edges[("A._lock", "B._lock")]
+    assert stack  # witness captured on first observation
+    assert tracker.acquisitions()["A._lock"] == 1
+    assert tracker.acquisitions()["B._lock"] == 2
+    assert tracker.report()["locks_wrapped"] == 2
+
+
+def test_condition_wrap_keeps_cv_protocol():
+    with locktrace.enabled() as tracker:
+        cv = locktrace.tracked(threading.Condition(), "C._cv")
+        assert isinstance(cv, locktrace.TracedCondition)
+        done = []
+
+        def worker():
+            with cv:
+                done.append(1)
+                cv.notify_all()
+
+        t = threading.Thread(target=worker)
+        with cv:
+            t.start()
+            while not done:
+                assert cv.wait(timeout=5.0)
+        t.join(timeout=5.0)
+    assert tracker.acquisitions()["C._cv"] >= 2
+
+
+def test_validation_passes_on_consistent_orders():
+    with locktrace.enabled() as tracker:
+        a = locktrace.tracked(threading.Lock(), "A._lock")
+        b = locktrace.tracked(threading.Lock(), "B._lock")
+        with a:
+            with b:
+                pass
+    tracker.assert_consistent({("A._lock", "B._lock")})
+    assert tracker.validate_against({("A._lock", "B._lock")}) == []
+
+
+def test_validation_flags_reversed_order():
+    with locktrace.enabled() as tracker:
+        a = locktrace.tracked(threading.Lock(), "A._lock")
+        b = locktrace.tracked(threading.Lock(), "B._lock")
+        with b:
+            with a:
+                pass
+    problems = tracker.validate_against({("A._lock", "B._lock")})
+    assert len(problems) == 1 and "REVERSES" in problems[0]
+    with pytest.raises(locktrace.LockOrderViolation):
+        tracker.assert_consistent({("A._lock", "B._lock")})
+
+
+def test_validation_flags_unknown_edge_as_call_graph_gap():
+    with locktrace.enabled() as tracker:
+        a = locktrace.tracked(threading.Lock(), "A._lock")
+        b = locktrace.tracked(threading.Lock(), "B._lock")
+        with a:
+            with b:
+                pass
+    problems = tracker.validate_against(set())
+    assert len(problems) == 1 and "call-graph gap" in problems[0]
+
+
+def test_acquire_release_protocol_and_reentrancy():
+    with locktrace.enabled() as tracker:
+        r = locktrace.tracked(threading.RLock(), "R._lock")
+        a = locktrace.tracked(threading.Lock(), "A._lock")
+        assert r.acquire()
+        assert r.acquire()       # re-entrant: no self-edge
+        with a:
+            pass
+        r.release()
+        r.release()
+    assert ("R._lock", "R._lock") not in tracker.edges()
+    assert ("R._lock", "A._lock") in tracker.edges()
+
+
+def test_per_thread_held_stacks_do_not_cross():
+    with locktrace.enabled() as tracker:
+        a = locktrace.tracked(threading.Lock(), "A._lock")
+        b = locktrace.tracked(threading.Lock(), "B._lock")
+        holding_a = threading.Event()
+        release_a = threading.Event()
+
+        def hold_a():
+            with a:
+                holding_a.set()
+                release_a.wait(timeout=5.0)
+
+        t = threading.Thread(target=hold_a)
+        t.start()
+        assert holding_a.wait(timeout=5.0)
+        with b:   # this thread holds nothing else: no A->B edge
+            pass
+        release_a.set()
+        t.join(timeout=5.0)
+    assert ("A._lock", "B._lock") not in tracker.edges()

@@ -229,6 +229,36 @@ def test_eviction_invalidates_only_the_evicted_coordinate(rng):
     assert delta["cold_bytes"] > 0
 
 
+def test_default_residency_singleton_under_thread_race():
+    """Regression for the PH013 bare lazy init: racing first calls must
+    all get ONE registry (two would split the TransferStats the mesh
+    bench gates on).  Resets the module global to exercise the
+    double-checked path, restoring it afterwards."""
+    import threading
+
+    from photon_ml_tpu.parallel import mesh_residency as mr
+
+    prev = mr._DEFAULT
+    try:
+        mr._DEFAULT = None
+        barrier = threading.Barrier(8)
+        got = []
+
+        def racer():
+            barrier.wait(timeout=5)
+            got.append(mr.default_residency())
+
+        threads = [threading.Thread(target=racer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert len(got) == 8
+        assert all(g is got[0] for g in got)
+    finally:
+        mr._DEFAULT = prev
+
+
 def test_clear_mesh_block_cache_alias_still_flushes():
     from photon_ml_tpu.parallel.random_effect import clear_mesh_block_cache
     clear_mesh_block_cache()

@@ -11,6 +11,7 @@ discipline on the `online.solve`/`online.publish` fault sites (transient
 retry, non-finite freeze — never a poisoned live table).
 """
 import logging
+import os
 import threading
 
 import jax
@@ -18,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from scipy.optimize import minimize
+
+import photon_ml_tpu
 
 from photon_ml_tpu.data.game_data import build_game_dataset
 from photon_ml_tpu.game.anchored import (anchored_objective_np, entity_rows,
@@ -37,7 +40,7 @@ from photon_ml_tpu.optim import OptimizerConfig
 from photon_ml_tpu.parallel.random_effect import EntityBlocks
 from photon_ml_tpu.serving import (Overloaded, ScoringService, ServingConfig,
                                    StaleDeltaError)
-from photon_ml_tpu.utils import faults
+from photon_ml_tpu.utils import faults, locktrace
 
 D_G, D_U, N_ENT = 6, 4, 30
 TASK = "logistic_regression"
@@ -305,7 +308,11 @@ def test_rollback_interleaved_swaps_and_deltas_under_scoring(rng):
     """ISSUE 9 satellite: interleave full-model swaps, delta swaps and
     rollbacks while a scoring thread hammers the service — rollback after
     N delta swaps restores the exact pre-delta rows, and the full-model
-    rollback still works beneath it."""
+    rollback still works beneath it.  Runs under the ARMED lock-order
+    tracker (ISSUE 10): every acquisition order this concurrency test
+    actually takes is validated against photonlint's static graph at the
+    end."""
+    tracker = locktrace.install()
     svc = _service(rng, updates=OnlineUpdateConfig(micro_batch=8))
     stop = threading.Event()
     errors = []
@@ -362,7 +369,200 @@ def test_rollback_interleaved_swaps_and_deltas_under_scoring(rng):
         stop.set()
         t.join(timeout=5)
         svc.close()
+        locktrace.shutdown()
     assert errors == []
+    # static/dynamic cross-validation: every lock order this test took
+    # must be an edge of the static acquisition-order graph
+    tracker.assert_consistent(lock_order_edges_cached())
+
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(photon_ml_tpu.__file__))
+
+
+def test_lock_tracker_stress_scoring_deltas_rollback(rng):
+    """ISSUE 10 acceptance: sustained scorer traffic + delta publishes +
+    full swap + delta-aware rollback under the ARMED lock tracker.  Every
+    observed acquisition order must be an edge consistent with the static
+    lock-order graph, and the serving metrics path must actually have
+    been observed nesting (the test would silently prove nothing if no
+    two locks ever nested)."""
+    with locktrace.enabled() as tracker:
+        svc = _service(rng, updates=OnlineUpdateConfig(micro_batch=8))
+        stop = threading.Event()
+        errors = []
+
+        def scorer_loop(seed):
+            r = np.random.default_rng(seed)
+            while not stop.is_set():
+                feats, ids, _ = _feedback(r, 3)
+                try:
+                    svc.score(feats, ids)
+                except Exception as e:  # pragma: no cover
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=scorer_loop, args=(s,),
+                                    daemon=True) for s in (11, 13)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(3):
+                feats, ids, labels = _feedback(rng, 16)
+                svc.feedback(feats, ids, labels)
+                svc.updater.flush()
+            assert svc.registry.pending_deltas() >= 1
+            from photon_ml_tpu.serving import CompiledScorer
+            scorer2 = CompiledScorer(_make_model(np.random.default_rng(7)),
+                                     max_batch=64, min_bucket=4)
+            scorer2.warmup()
+            svc.registry.install(scorer2, "v2")
+            feats, ids, labels = _feedback(rng, 16)
+            svc.feedback(feats, ids, labels)
+            svc.updater.flush()
+            svc.rollback()          # delta-aware
+            svc.rollback()          # full-model
+            svc.metrics_snapshot()
+            svc.prometheus_metrics()
+            svc.updater.stats()
+            svc.updater.frozen_entities()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            svc.close()
+    assert errors == []
+    static = lock_order_edges_cached()
+    tracker.assert_consistent(static)
+    observed = set(tracker.edges())
+    # the nesting the serving hot path is KNOWN to take — proves the
+    # tracker saw real contention-relevant structure, not an empty run
+    assert ("ServingMetrics._lock", "Counter._lock") in observed
+    assert observed <= static
+    acq = tracker.acquisitions()
+    assert acq.get("ModelRegistry._lock", 0) > 0
+    assert acq.get("FeedbackBuffer._lock", 0) > 0
+    assert acq.get("OnlineUpdater._state_lock", 0) > 0
+    assert acq.get("MicroBatcher._cv", 0) > 0
+
+
+_STATIC_EDGES = None
+
+
+def lock_order_edges_cached():
+    """The package's static lock-order graph, computed once per test
+    session (the interprocedural pass costs ~1s)."""
+    global _STATIC_EDGES
+    if _STATIC_EDGES is None:
+        from photon_ml_tpu.analysis.concurrency import lock_order_edges
+        _STATIC_EDGES = lock_order_edges([PACKAGE_DIR])
+    return _STATIC_EDGES
+
+
+def test_updater_start_close_race_spawns_one_thread(rng):
+    """Regression for the PH013 check-then-act in OnlineUpdater.start():
+    N racing start() calls must launch exactly ONE loop thread, and
+    close() must join it without deadlocking (it joins OUTSIDE the state
+    lock the loop thread takes — the PH012 hazard)."""
+    svc = _service(rng, updates=OnlineUpdateConfig(micro_batch=8),
+                   start_updater=False)
+    try:
+        before = {t.ident for t in threading.enumerate()}
+        barrier = threading.Barrier(8)
+
+        def racer():
+            barrier.wait(timeout=5)
+            svc.updater.start()
+
+        racers = [threading.Thread(target=racer) for _ in range(8)]
+        for t in racers:
+            t.start()
+        for t in racers:
+            t.join(timeout=5)
+        spawned = [t for t in threading.enumerate()
+                   if t.ident not in before
+                   and t.name == "photon-online-updater"]
+        assert len(spawned) == 1
+        svc.updater.close(timeout=5)
+        assert not spawned[0].is_alive()
+        # restartable after close
+        svc.updater.start()
+        svc.updater.close(timeout=5)
+    finally:
+        svc.close()
+
+
+def test_batcher_shed_callback_runs_outside_the_condition(rng):
+    """Regression for the shed callback being invoked under _cv: a
+    callback that itself touches the batcher (as ServingMetrics-style
+    hooks legitimately may) must not deadlock."""
+    from photon_ml_tpu.serving.batcher import BatcherConfig, MicroBatcher
+
+    release = threading.Event()
+    calls = []
+
+    def slow_score(feats, ids, num_requests, queue_wait_s):
+        release.wait(timeout=10)
+
+        class R:
+            scores = np.zeros(int(next(iter(feats.values())).shape[0]))
+        return R()
+
+    def on_shed():
+        # re-enters the batcher: deadlocks if invoked while _cv is held
+        calls.append(batcher.pending)
+
+    batcher = MicroBatcher(slow_score,
+                           BatcherConfig(max_wait_s=0.001, max_batch=4,
+                                         max_queue=1),
+                           on_shed=on_shed)
+    try:
+        import time as _time
+        feats = {"global": np.zeros((1, D_G))}
+        ids = {"userId": np.asarray(["u0"], dtype=object)}
+
+        def submit():
+            try:
+                batcher.score(feats, ids, 1)
+            except Exception:
+                pass
+
+        def wait_pending(n):
+            deadline = _time.monotonic() + 5
+            while _time.monotonic() < deadline:
+                if batcher.pending == n:
+                    return True
+                _time.sleep(0.005)
+            return False
+
+        # 1st request: picked up by the worker, which blocks in score_fn
+        threading.Thread(target=submit, daemon=True).start()
+        assert wait_pending(0)
+        # 2nd request fills the queue (max_queue=1) behind the stuck worker
+        threading.Thread(target=submit, daemon=True).start()
+        assert wait_pending(1)
+        # 3rd request must shed IMMEDIATELY — and the callback re-enters
+        # the batcher, which deadlocks if it ran under _cv
+        with pytest.raises(Overloaded):
+            batcher.score(feats, ids, 1)
+        assert calls and all(isinstance(c, int) for c in calls)
+    finally:
+        release.set()
+        batcher.close()
+
+
+def test_disarmed_tracker_is_pass_through_on_live_service(rng):
+    """ISSUE 10 acceptance (zero overhead disarmed): with no tracker
+    installed the serving stack builds on RAW threading primitives — the
+    module-global None check returns the lock unchanged, so the warm
+    serve loop pays nothing (its zero-fresh-traces gate lives in
+    test_zero_fresh_traces_warm_delta_stream)."""
+    assert locktrace.active() is None
+    svc = _service(rng)
+    try:
+        assert type(svc.registry._lock) is type(threading.Lock())
+        assert isinstance(svc._batcher._cv, threading.Condition)
+        assert not isinstance(svc.registry._lock, locktrace.TracedLock)
+    finally:
+        svc.close()
 
 
 def test_delta_rollback_bit_exact_multiple_overlapping(rng):

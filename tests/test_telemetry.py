@@ -267,6 +267,29 @@ def test_emitter_without_tracer_stays_silent():
     assert len(sink.got) == 1
 
 
+def test_clear_listeners_close_hooks_run_outside_the_lock():
+    """Regression for listener close hooks running under the emitter
+    lock: a close() that re-enters the emitter (registering a
+    replacement, clearing again) must not deadlock — the listener list
+    is swapped under the lock and closed OUTSIDE it."""
+    emitter = EventEmitter()
+    closed = []
+
+    class Reentrant(EventListener):
+        def handle(self, event):
+            pass
+
+        def close(self):
+            closed.append(True)
+            emitter.register_listener(_Sink())   # takes the emitter lock
+
+    emitter.register_listener(Reentrant())
+    emitter.clear_listeners()                    # deadlocked before fix
+    assert closed == [True]
+    # the re-registered sink survived the clear (it landed after swap)
+    emitter.send_event(TrainingStartEvent(time=3.0))
+
+
 # --------------------------------------------------------------------------
 # hot-path regression gates
 # --------------------------------------------------------------------------
