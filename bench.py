@@ -3206,6 +3206,400 @@ def online_bench(out_path="BENCH_online.json", smoke=False, max_wall=None):
 
 
 # --------------------------------------------------------------------------
+# --health: live model-health observability (photon_ml_tpu/health/)
+# --------------------------------------------------------------------------
+
+def _health_config(smoke: bool, **kw):
+    from photon_ml_tpu.health import HealthConfig
+    kw.setdefault("window_labels", 128 if smoke else 256)
+    kw.setdefault("window_scores", 512 if smoke else 2048)
+    kw.setdefault("baseline_scores", 512 if smoke else 2048)
+    kw.setdefault("sustain_windows", 2)
+    kw.setdefault("recovery_windows", 2)
+    kw.setdefault("calibration_p_min", 1e-4)
+    kw.setdefault("psi_max", 0.25)
+    kw.setdefault("ks_max", 0.2)
+    return HealthConfig(**kw)
+
+
+def _health_service(rng, *, smoke, health, updates=True, E=None, **hc_kw):
+    from photon_ml_tpu.online import OnlineUpdateConfig
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+    E = E if E is not None else (400 if smoke else 2000)
+    svc = ScoringService(
+        model=_online_model(rng, 16, 8, E),
+        config=ServingConfig(max_batch=256, min_bucket=8),
+        updates=OnlineUpdateConfig(micro_batch=8) if updates else None,
+        start_updater=False,
+        health=_health_config(smoke, **hc_kw) if health else None)
+    return svc, [f"u{i}" for i in range(E)]
+
+
+def _calibrated_batch(svc, rng, entities, n, flip=False, scale=1.0):
+    """Feedback whose labels are drawn from the LIVE model's own
+    probabilities — calibrated by construction; `flip` inverts them
+    (the label-flip drift injection), `scale` shifts the covariates
+    (the covariate-shift injection)."""
+    d_g, d_u = 16, 8
+    feats = {"global": scale * rng.normal(size=(n, d_g)),
+             "per_user": scale * rng.normal(size=(n, d_u))}
+    ids = {"userId": np.asarray(
+        [entities[rng.integers(0, len(entities))] for _ in range(n)],
+        dtype=object)}
+    z = svc.registry.scorer.score(feats, ids).scores
+    p = 0.5 * (1.0 + np.tanh(0.5 * z))
+    y = (rng.uniform(size=n) < p).astype(float)
+    if flip:
+        y = 1.0 - y
+    return feats, ids, y
+
+
+def _health_stationary_entry(smoke: bool) -> dict:
+    """Gate: ZERO gate trips across a stationary leg — calibrated labels,
+    unshifted covariates, live delta publishes the whole time (the
+    false-alarm bound of the whole service path, not just the
+    detectors)."""
+    rng = np.random.default_rng(71)
+    svc, entities = _health_service(rng, smoke=smoke, health=True)
+    cfg = svc.health.config
+    label_windows = 4 if smoke else 6
+    score_windows = 3 if smoke else 4
+    try:
+        # drift baseline + score windows (scoring traffic only)
+        rows = cfg.baseline_scores + score_windows * cfg.window_scores
+        for lo in range(0, rows, 256):
+            f, i, _ = _calibrated_batch(svc, rng, entities,
+                                        min(256, rows - lo))
+            svc.score(f, i)
+        for _ in range(label_windows):
+            f, i, y = _calibrated_batch(svc, rng, entities,
+                                        cfg.window_labels)
+            svc.feedback(f, i, y)
+            svc.updater.flush()
+        snap = svc.metrics_snapshot()
+        v = svc.health.verdict()
+        gate_values = {name: g["value"] for name, g in v["gates"].items()}
+        return {
+            "name": "health_stationary",
+            "label_windows": snap["health"]["label_windows"],
+            "score_windows": snap["health"]["score_windows"],
+            "deltas_published": snap["online"]["deltas_published"],
+            "gate_trips": snap["health"]["gate_trips"],
+            "breaches": snap["health"]["breaches"],
+            "last_gate_values": gate_values,
+            "status": v["status"],
+            "stationary_ok": bool(
+                snap["health"]["gate_trips"] == 0
+                and v["status"] == "ok"
+                and snap["health"]["label_windows"] >= label_windows
+                and snap["health"]["score_windows"] >= score_windows
+                and snap["online"]["deltas_published"] > 0),
+        }
+    finally:
+        svc.close()
+
+
+def _health_label_flip_entry(smoke: bool) -> dict:
+    """Gate: injected label-flip drift trips the calibration gate within
+    <= 3 evaluation windows, pauses the updater, flips /healthz to
+    degraded — and the paused updater stops publishing while intake keeps
+    buffering."""
+    rng = np.random.default_rng(73)
+    svc, entities = _health_service(rng, smoke=smoke, health=True,
+                                    rollback_on=("calibration",))
+    cfg = svc.health.config
+    try:
+        # the pre-delta state a health rollback must restore bit-exactly
+        table0 = np.asarray(
+            svc.registry.scorer.re_table("perUser")).copy()
+        # clean warmup: 2 calibrated windows + deltas pending for rollback
+        for _ in range(2):
+            f, i, y = _calibrated_batch(svc, rng, entities,
+                                        cfg.window_labels)
+            svc.feedback(f, i, y)
+            svc.updater.flush()
+        deltas_before = svc.registry.pending_deltas()
+        assert svc.healthz()["status"] == "ok"
+        windows_before = svc.health.verdict()["windows_evaluated"]
+        windows_to_trip = None
+        for w in range(1, 7):
+            f, i, y = _calibrated_batch(svc, rng, entities,
+                                        cfg.window_labels, flip=True)
+            svc.feedback(f, i, y)
+            if svc.healthz()["status"] == "degraded":
+                windows_to_trip = (svc.health.verdict()["windows_evaluated"]
+                                   - windows_before)
+                break
+        hz = svc.healthz()
+        published_paused = svc.updater.flush()["deltas"]
+        rolled_back = bool(
+            svc.registry.pending_deltas() == 0 and deltas_before > 0
+            and np.array_equal(
+                np.asarray(svc.registry.scorer.re_table("perUser")),
+                table0))
+        return {
+            "name": "health_label_flip",
+            "detection_gate_windows": 3,
+            "windows_to_trip": windows_to_trip,
+            "status": hz["status"],
+            "updater_paused": bool(svc.updater.paused),
+            "deltas_published_while_paused": published_paused,
+            "deltas_rolled_back": deltas_before,
+            "rollback_restored_pre_delta_rows": rolled_back,
+            "calibration_p_value":
+                hz["health"]["gates"]["calibration"]["value"],
+            "label_flip_ok": bool(
+                windows_to_trip is not None and windows_to_trip <= 3
+                and hz["status"] == "degraded" and svc.updater.paused
+                and published_paused == 0 and rolled_back),
+        }
+    finally:
+        svc.close()
+
+
+def _health_covariate_entry(smoke: bool) -> dict:
+    """Gate: injected covariate shift moves the score distribution and
+    trips a drift gate (PSI/KS vs the install baseline) within <= 3
+    evaluation windows — labels never needed."""
+    rng = np.random.default_rng(79)
+    svc, entities = _health_service(rng, smoke=smoke, health=True,
+                                    updates=False)
+    cfg = svc.health.config
+    try:
+        rows = cfg.baseline_scores + cfg.window_scores   # baseline + clean
+        for lo in range(0, rows, 256):
+            f, i, _ = _calibrated_batch(svc, rng, entities,
+                                        min(256, rows - lo))
+            svc.score(f, i)
+        assert svc.health.verdict()["baseline_ready"]
+        windows_before = svc.health.verdict()["windows_evaluated"]
+        windows_to_trip = None
+        for w in range(1, 7):
+            for lo in range(0, cfg.window_scores, 256):
+                f, i, _ = _calibrated_batch(
+                    svc, rng, entities,
+                    min(256, cfg.window_scores - lo), scale=2.5)
+                svc.score(f, i)
+            if svc.healthz()["status"] == "degraded":
+                windows_to_trip = (svc.health.verdict()["windows_evaluated"]
+                                   - windows_before)
+                break
+        v = svc.health.verdict()
+        return {
+            "name": "health_covariate_shift",
+            "detection_gate_windows": 3,
+            "windows_to_trip": windows_to_trip,
+            "psi": v["gates"]["drift_psi"]["value"],
+            "ks": v["gates"]["drift_ks"]["value"],
+            "tripped_gates": [n for n, g in v["gates"].items()
+                              if g["tripped"]],
+            "covariate_ok": bool(windows_to_trip is not None
+                                 and windows_to_trip <= 3
+                                 and v["status"] == "degraded"),
+        }
+    finally:
+        svc.close()
+
+
+def _health_latency_entry(smoke: bool) -> dict:
+    """Gate: scoring p99 with health ARMED <= 1.1x disarmed.  Same
+    best-of-reps methodology as the online-latency leg: the armed run
+    pays one histogram add per batch plus the window evaluations that
+    close DURING the stream."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+
+    rng = np.random.default_rng(83)
+    d_g, d_u = 16, 8
+    E = 1000 if smoke else 20_000
+    n_requests = 200 if smoke else max(int(1500 * _SCALE), 300)
+    threads = 8
+    entities = [f"u{i}" for i in range(E)]
+    cfg = ServingConfig(max_batch=256, min_bucket=8, max_wait_s=0.002,
+                        max_queue=4096, latency_window=n_requests)
+    requests = []
+    for _ in range(n_requests):
+        k = int(rng.integers(1, 9))
+        requests.append((
+            {"global": rng.normal(size=(k, d_g)),
+             "per_user": rng.normal(size=(k, d_u))},
+            {"userId": np.asarray(
+                [entities[rng.integers(0, E)] for _ in range(k)],
+                dtype=object)}))
+
+    def run_stream(svc):
+        errors = []
+
+        def one(req):
+            try:
+                svc.score(*req)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            list(pool.map(one, requests))
+        return time.perf_counter() - t0, errors
+
+    reps = 1 if smoke else 3
+    results = {}
+    for mode, health in (("disarmed", None),
+                         ("armed", _health_config(
+                             smoke, window_scores=256,
+                             baseline_scores=256))):
+        svc = ScoringService(model=_online_model(rng, d_g, d_u, E),
+                             config=cfg, health=health)
+        try:
+            run_stream(svc)  # warm buckets (and the drift baseline)
+            p99s, walls, errs = [], [], []
+            for _ in range(reps):
+                wall, e = run_stream(svc)
+                walls.append(wall)
+                errs += e
+                p99s.append(svc.metrics_snapshot()["latency_ms"]["p99"])
+            results[mode] = {
+                "p99_ms": min(p99s), "p99_ms_reps": p99s,
+                "wall_s": round(min(walls), 3), "errors": len(errs)}
+            if health is not None:
+                snap = svc.metrics_snapshot()["health"]
+                results[mode]["score_windows"] = snap["score_windows"]
+                results[mode]["gate_trips"] = snap["gate_trips"]
+        finally:
+            svc.close()
+    ratio = results["armed"]["p99_ms"] / max(results["disarmed"]["p99_ms"],
+                                             1e-9)
+    return {
+        "name": "health_latency",
+        "requests": n_requests, "threads": threads, "reps": reps,
+        "disarmed": results["disarmed"], "armed": results["armed"],
+        "p99_ratio": round(ratio, 3),
+        "latency_gate": 1.1,
+        "latency_ok": bool(ratio <= 1.1
+                           and not results["disarmed"]["errors"]
+                           and not results["armed"]["errors"]
+                           and results["armed"]["score_windows"] > 0),
+    }
+
+
+def _health_traces_entry(smoke: bool) -> dict:
+    """Gate: zero fresh XLA traces steady-state with health ARMED and
+    DISARMED — window closes and gate evaluations included in the
+    counted region (all health math is host numpy/scipy)."""
+    rng = np.random.default_rng(89)
+    out = {"name": "health_steady_state_traces"}
+    for mode, health in (("disarmed", False), ("armed", True)):
+        svc, entities = _health_service(
+            rng, smoke=smoke, health=health, E=400,
+            **({"window_labels": 32, "window_scores": 64,
+                "baseline_scores": 64, "sustain_windows": 1000}
+               if health else {}))
+        try:
+            svc.updater.warmup()
+
+            def one_round(seed):
+                r = np.random.default_rng(seed)
+                f, i, y = _calibrated_batch(svc, r, entities[:64], 32)
+                svc.feedback(f, i, y)
+                svc.updater.flush()
+                f2, i2, _ = _calibrated_batch(svc, r, entities, 64)
+                svc.score(f2, i2)
+
+            for s in range(2):
+                one_round(s)
+            steady = 3 if smoke else 8
+            with _trace_counting() as counter:
+                for s in range(2, 2 + steady):
+                    one_round(s)
+            snap = svc.metrics_snapshot()
+            out[mode] = {
+                "steady_rounds": steady,
+                "fresh_traces": counter.count,
+                "deltas_absorbed": svc.registry.scorer.deltas_applied,
+                "label_windows": snap["health"]["label_windows"],
+                "score_windows": snap["health"]["score_windows"],
+            }
+        finally:
+            svc.close()
+    out["zero_traces_ok"] = bool(
+        out["armed"]["fresh_traces"] == 0
+        and out["disarmed"]["fresh_traces"] == 0
+        and out["armed"]["label_windows"] >= 3
+        and out["armed"]["score_windows"] >= 1)
+    return out
+
+
+def health_bench(out_path="BENCH_health.json", smoke=False, max_wall=None):
+    """Model-health gate (--health): (1) injected label-flip drift
+    detected (calibration gate tripped, updater paused, delta rollback)
+    within <= 3 evaluation windows; (2) injected covariate-shift drift
+    detected within <= 3 windows; (3) ZERO gate trips across the
+    stationary leg; (4) scoring p99 with health armed <= 1.1x disarmed;
+    (5) zero fresh XLA traces steady-state armed and disarmed.  `value`
+    is the worst detection latency in windows."""
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    t0 = time.perf_counter()
+    entries = []
+    truncated = []
+    legs = [
+        ("health_stationary", _health_stationary_entry),
+        ("health_label_flip", _health_label_flip_entry),
+        ("health_covariate_shift", _health_covariate_entry),
+        ("health_traces", _health_traces_entry),
+        ("health_latency", _health_latency_entry),
+    ]
+    for name, fn in legs:
+        if max_wall is not None and time.perf_counter() - t0 > max_wall:
+            truncated.append(name)
+            continue
+        entries.append(fn(smoke))
+    by_name = {e["name"]: e for e in entries}
+    stationary = by_name.get("health_stationary", {})
+    flip = by_name.get("health_label_flip", {})
+    covariate = by_name.get("health_covariate_shift", {})
+    traces = by_name.get("health_steady_state_traces", {})
+    latency = by_name.get("health_latency", {})
+    gates = {
+        "stationary_ok": stationary.get("stationary_ok"),
+        "label_flip_ok": flip.get("label_flip_ok"),
+        "covariate_ok": covariate.get("covariate_ok"),
+        "zero_traces_ok": traces.get("zero_traces_ok"),
+        "latency_ok": latency.get("latency_ok"),
+    }
+    # latency is a smoke SIGNAL under the tier-1 suite (shared cores), a
+    # HARD gate on the committed full run — same policy as --online
+    hard = ["stationary_ok", "label_flip_ok", "covariate_ok",
+            "zero_traces_ok"]
+    if not smoke:
+        hard.append("latency_ok")
+    detections = [w for w in (flip.get("windows_to_trip"),
+                              covariate.get("windows_to_trip"))
+                  if w is not None]
+    result = {
+        "metric": "health_worst_detection_latency_windows",
+        "value": max(detections) if detections else None,
+        "unit": "evaluation windows",
+        "detail": {
+            "smoke": smoke,
+            "entries": entries,
+            **gates,
+            "all_ok": all(bool(gates[g]) for g in hard),
+            "hard_gates": hard,
+            "truncated": truncated or False,
+            "suite_wall_s": round(time.perf_counter() - t0, 1),
+        },
+    }
+    _embed_telemetry(result)
+    tmp_path = out_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp_path, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 
 def warm_ref_cache():
     """Compute every GLM config's float64 CPU reference (optimum + solve
@@ -3405,6 +3799,13 @@ def _dispatch():
         paths = [a for i, a in enumerate(rest) if not a.startswith("--")
                  and (i == 0 or rest[i - 1] != "--max-wall")]
         online_bench(*(paths[:1] or ["BENCH_online.json"]), smoke=smoke,
+                     max_wall=_parse_max_wall(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--health":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        health_bench(*(paths[:1] or ["BENCH_health.json"]), smoke=smoke,
                      max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--pipeline":
         pipeline_bench(*sys.argv[2:3])

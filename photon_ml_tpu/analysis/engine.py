@@ -21,7 +21,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 #: directory components whose modules are hot paths (PH001 applies)
-HOT_PATH_DIRS = ("ops", "optim", "game", "parallel", "serving", "online")
+HOT_PATH_DIRS = ("ops", "optim", "game", "parallel", "serving", "online",
+                 "health")
 
 #: path suffixes of modules whose file writes must be durable (PH005);
 #: utils/durable.py is the helper implementation and is exempt

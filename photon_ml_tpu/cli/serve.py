@@ -28,8 +28,14 @@ HTTP mode (default) — a dependency-free stdlib server:
                                               swaps revert to exact
                                               pre-delta rows, else the
                                               previous full model
-  GET  /healthz                            -> status + version vector
-                                              (model version, delta seq)
+  GET  /healthz                            -> status + version vector +
+                                              updater vitals (thread
+                                              liveness, last-cycle age,
+                                              frozen entities) + the
+                                              per-gate health verdict;
+                                              HTTP 503 when a health gate
+                                              is tripped (status
+                                              "degraded")
 
   429 = Overloaded (queue full), 504 = DeadlineExceeded, 400 = bad request.
   SIGUSR1 dumps a metrics snapshot to stderr; --metrics-interval dumps one
@@ -91,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--feedback-max-pending", type=int, default=8192,
                    help="pending feedback rows before backpressure "
                         "(Overloaded / HTTP 429)")
+    p.add_argument("--health-config", default=None, metavar="JSON",
+                   help="arm the model-health monitor: HealthConfig as "
+                        "inline JSON or @file ('{}' = defaults). Streaming "
+                        "calibration + drift gates flip /healthz to "
+                        "degraded, pause the online updater, and per "
+                        "rollback_on trigger the delta-aware rollback")
     p.add_argument("--event-listener", action="append", default=[],
                    help="dotted EventListener class path (repeatable); "
                         "receives ScoringBatchEvent/ModelSwapEvent")
@@ -130,8 +142,13 @@ def _build_service(args):
             anchor_weight=args.update_anchor_weight,
             interval_s=args.update_interval_ms / 1e3,
             max_pending_rows=args.feedback_max_pending)
+    health = None
+    if args.health_config is not None:
+        from photon_ml_tpu.cli.train import _load_json_arg
+        from photon_ml_tpu.health import HealthConfig
+        health = HealthConfig.from_dict(_load_json_arg(args.health_config))
     return ScoringService(model_dir=args.model_dir, config=cfg,
-                          emitter=emitter, updates=updates)
+                          emitter=emitter, updates=updates, health=health)
 
 
 def _dump_metrics(service, stream=sys.stderr):
@@ -246,11 +263,11 @@ def _make_http_server(service, host: str, port: int):
             elif self.path == "/metrics.json":
                 self._reply(200, service.metrics_snapshot())
             elif self.path == "/healthz":
-                self._reply(200, {
-                    "status": "ok",
-                    "model_version": service.model_version,
-                    "version_vector": service.version_vector(),
-                    "updates_enabled": service.updater is not None})
+                payload = service.healthz()
+                # degraded -> 503 so a stock load balancer / Kubernetes
+                # probe takes the replica out without parsing the body
+                self._reply(200 if payload["status"] == "ok" else 503,
+                            payload)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -341,6 +358,7 @@ def main(argv=None) -> int:
         "model_load_s": round(load_s, 3),
         "buckets": service.registry.scorer.bucket_sizes(),
         "updates_enabled": service.updater is not None,
+        "health_enabled": service.health is not None,
         "endpoints": ["/score", "/predict", "/feedback", "/metrics",
                       "/metrics.json", "/swap", "/rollback", "/healthz"],
     }), flush=True)

@@ -104,11 +104,16 @@ class OnlineUpdater:
 
     def __init__(self, registry, metrics=None,
                  config: OnlineUpdateConfig = OnlineUpdateConfig(),
-                 emitter=None):
+                 emitter=None, health=None):
+        """`health` (a health.HealthMonitor) receives per-delta magnitude
+        and freeze vitals, and is what `pause()`/`resume()` exist for:
+        the monitor's gates stop the update loop while the model is
+        degrading and restart it on recovery."""
         self.registry = registry
         self.metrics = metrics
         self.config = config
         self.emitter = emitter
+        self.health = health
         self.buffer = FeedbackBuffer(max_rows=config.max_pending_rows,
                                      entity_window=config.entity_window,
                                      dedup_window=config.dedup_window)
@@ -125,6 +130,9 @@ class OnlineUpdater:
         self.cycles = 0                                   # photonlint: guarded-by=_state_lock
         self.deltas_published = 0                         # photonlint: guarded-by=_state_lock
         self.last_error: Optional[str] = None             # photonlint: guarded-by=_state_lock
+        self._paused = False                              # photonlint: guarded-by=_state_lock
+        self.pause_reason: Optional[str] = None           # photonlint: guarded-by=_state_lock
+        self._last_cycle_at: Optional[float] = None       # photonlint: guarded-by=_state_lock
         self._wake = threading.Event()
         self._closed = threading.Event()
         self._jitter = random.Random(0xC0FFEE)
@@ -263,9 +271,12 @@ class OnlineUpdater:
     def run_once(self) -> Dict[str, int]:
         """One drain-solve-publish cycle over every coordinate with
         pending feedback.  Returns {"entities": ..., "rows": ...,
-        "deltas": ...} for what was published."""
-        scorer = self.registry.scorer  # ONE version for the whole cycle
+        "deltas": ...} for what was published.  A no-op while paused
+        (health gate / operator): pending feedback stays buffered."""
         totals = {"entities": 0, "rows": 0, "deltas": 0}
+        if self.paused:
+            return totals
+        scorer = self.registry.scorer  # ONE version for the whole cycle
         for lane, shard, re_type in scorer.updatable_coordinates():
             if self.buffer.pending_entities(lane) == 0:
                 continue
@@ -280,13 +291,15 @@ class OnlineUpdater:
                 totals["entities"] += published["entities"]
                 totals["rows"] += published["rows"]
                 totals["deltas"] += 1
+        with self._state_lock:
+            self._last_cycle_at = clock()
         return totals
 
     def flush(self, max_cycles: int = 1000) -> Dict[str, int]:
         """Drain the buffer to empty (tests / bench determinism)."""
         totals = {"entities": 0, "rows": 0, "deltas": 0}
         for _ in range(max_cycles):
-            if not self.buffer.lanes():
+            if not self.buffer.lanes() or self.paused:
                 break
             out = self.run_once()
             for k in totals:
@@ -294,6 +307,63 @@ class OnlineUpdater:
             if out["deltas"] == 0 and out["entities"] == 0:
                 break  # nothing publishable remains (all frozen/stale)
         return totals
+
+    # -- health gating --------------------------------------------------------
+
+    def pause(self, reason: Optional[str] = None) -> None:
+        """Stop publishing updates (the loop idles; `submit` keeps
+        buffering so recovery detection still sees labels).  Idempotent."""
+        with self._state_lock:
+            if self._paused:
+                return
+            self._paused = True
+            self.pause_reason = reason
+        telemetry.event("online_updates_paused", reason=str(reason))
+        logger.warning("online updates PAUSED (%s)", reason)
+
+    def resume(self) -> None:
+        """Resume publishing; buffered feedback drains on the next cycle."""
+        with self._state_lock:
+            if not self._paused:
+                return
+            self._paused = False
+            self.pause_reason = None
+        telemetry.event("online_updates_resumed")
+        logger.info("online updates resumed")
+        self._wake.set()
+
+    @property
+    def paused(self) -> bool:
+        with self._state_lock:
+            return self._paused
+
+    def last_cycle_age_s(self) -> Optional[float]:
+        """Seconds since the last completed update cycle (None before
+        the first)."""
+        with self._state_lock:
+            last = self._last_cycle_at
+        return None if last is None else clock() - last
+
+    def alive(self) -> bool:
+        """Is the background loop thread running?  (False under manual
+        `run_once()` driving — tests/bench — and after close().)"""
+        with self._state_lock:
+            thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    def probe(self) -> Dict[str, object]:
+        """Live vitals for the metric surfaces and /healthz (refreshed at
+        render by ServingMetrics._refresh_online_gauges)."""
+        with self._state_lock:
+            frozen = len(self._frozen)
+            paused = self._paused
+            reason = self.pause_reason
+            last = self._last_cycle_at
+            thread = self._thread
+        return {"frozen": frozen, "paused": paused, "pause_reason": reason,
+                "alive": thread is not None and thread.is_alive(),
+                "last_cycle_age_s": (None if last is None
+                                     else clock() - last)}
 
     def _blocks_for(self, scorer, shard: str,
                     drained: List[EntityFeedback]):
@@ -426,6 +496,8 @@ class OnlineUpdater:
                 self.buffer.drop_entity(lane, ef.entity_id)
                 if self.metrics is not None:
                     self.metrics.observe_frozen_entity()
+                if self.health is not None:
+                    self.health.observe_freeze(lane)
                 telemetry.event("online_quarantine", coordinate=lane,
                                 entity=str(ef.entity_id))
                 logger.warning("online solve for %r entity %r produced "
@@ -437,6 +509,16 @@ class OnlineUpdater:
             keep_prior.append(prior_np[e])
             latencies.append(now - ef.first_enqueued_at)
         if not keep_rows:
+            return None
+        if self.paused:
+            # a health gate paused us MID-CYCLE (and may be rolling the
+            # pending deltas back): rows solved against the pre-pause
+            # state must not land after the rollback — requeue them and
+            # let the post-recovery cycle re-solve against whatever
+            # model is live then
+            self.buffer.requeue(lane, drained)
+            telemetry.event("online_publish_skipped_paused",
+                            coordinate=lane)
             return None
         delta = ModelDelta(
             base_version=scorer.version, seq=self.registry.next_delta_seq(),
@@ -473,6 +555,12 @@ class OnlineUpdater:
         if self.metrics is not None:
             for lat in latencies:
                 self.metrics.observe_feedback_to_publish(lat)
+        if self.health is not None:
+            # delta-magnitude vitals: L2 of each published row's move away
+            # from its prior (the health monitor gates on the window max)
+            self.health.observe_published(
+                lane, np.linalg.norm(
+                    np.stack(keep_values) - np.stack(keep_prior), axis=1))
         with self._state_lock:
             self.deltas_published += 1
         return {"entities": len(keep_rows), "rows": num_rows}
@@ -492,7 +580,7 @@ class OnlineUpdater:
                 if not faults.is_transient(e) or attempt >= cfg.max_attempts:
                     raise
                 if self.metrics is not None:
-                    self.metrics.observe_solve_retry()
+                    self.metrics.observe_publish_retry()
                 telemetry.event("online_publish_retry", coordinate=lane,
                                 attempt=attempt,
                                 error=f"{type(e).__name__}: {e}")
@@ -511,6 +599,8 @@ class OnlineUpdater:
             return {"cycles": self.cycles,
                     "deltas_published": self.deltas_published,
                     "frozen": len(self._frozen),
+                    "paused": self._paused,
+                    "pause_reason": self.pause_reason,
                     "buffer": buffer_stats,
                     "last_error": self.last_error}
 

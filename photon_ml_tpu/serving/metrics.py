@@ -34,9 +34,85 @@ from photon_ml_tpu.telemetry.metrics import MetricsRegistry
 from photon_ml_tpu.utils import locktrace
 
 
+#: instrument name -> key path in the `snapshot()` JSON surface.  This map
+#: is the metric-surface parity CONTRACT: every instrument the constructor
+#: registers must appear here, and every path must resolve in a rendered
+#: snapshot — tests/test_health.py diffs all three sets, so a new metric
+#: cannot land on the Prometheus surface without its JSON twin (or vice
+#: versa).  Several counters render as one derived ratio (occupancy,
+#: hit rate): they share a path.
+SNAPSHOT_PATHS = {
+    "serving.requests": ("requests",),
+    "serving.rows": ("rows",),
+    "serving.batches": ("batches",),
+    "serving.batched_rows": ("batch_occupancy",),
+    "serving.bucket_rows": ("batch_occupancy",),
+    "serving.shed": ("shed",),
+    "serving.deadline_exceeded": ("deadline_exceeded",),
+    "serving.errors": ("errors",),
+    "serving.entity_lookups": ("entity_hit_rate",),
+    "serving.entity_hits": ("entity_hit_rate",),
+    "serving.bucket_compiles": ("bucket_compiles",),
+    "serving.swaps": ("swaps",),
+    "serving.rollbacks": ("rollbacks",),
+    "serving.requests_per_batch_sum": ("requests_per_batch",),
+    "serving.queue_wait_s": ("mean_queue_wait_ms",),
+    "serving.batch_score_s": ("mean_batch_score_ms",),
+    "serving.latency_s": ("latency_ms",),
+    "serve.model_age_s": ("model_age_s",),
+    "online.feedback_requests": ("online", "feedback_requests"),
+    "online.feedback_rows": ("online", "feedback_rows"),
+    "online.feedback_lane_rows": ("online", "feedback_lane_rows"),
+    "online.feedback_dropped_unseen": ("online", "dropped_unseen"),
+    "online.feedback_dropped_frozen": ("online", "dropped_frozen"),
+    "online.feedback_deduped": ("online", "deduped"),
+    "online.feedback_coalesced": ("online", "coalesced"),
+    "online.feedback_shed": ("online", "shed"),
+    "online.update_cycles": ("online", "update_cycles"),
+    "online.entities_updated": ("online", "entities_updated"),
+    "online.rows_trained": ("online", "rows_trained"),
+    "online.deltas_published": ("online", "deltas_published"),
+    "online.delta_rows": ("online", "delta_rows"),
+    "online.stale_deltas": ("online", "stale_deltas"),
+    "online.freezes": ("online", "freezes"),
+    "online.frozen_entities": ("online", "frozen_entities"),
+    "online.last_cycle_age_s": ("online", "last_cycle_age_s"),
+    "online.updater_alive": ("online", "updater_alive"),
+    "online.solve_retries": ("online", "solve_retries"),
+    "online.publish_retries": ("online", "publish_retries"),
+    "online.solve_failures": ("online", "solve_failures"),
+    "online.publish_s": ("online", "mean_publish_ms"),
+    "online.feedback_to_publish_s": ("online", "feedback_to_publish_ms"),
+    "health.label_windows": ("health", "label_windows"),
+    "health.score_windows": ("health", "score_windows"),
+    "health.labels": ("health", "labels"),
+    "health.breaches": ("health", "breaches"),
+    "health.gate_trips": ("health", "gate_trips"),
+    "health.recoveries": ("health", "recoveries"),
+    "health.rollbacks": ("health", "rollbacks"),
+    "health.evaluate_skipped": ("health", "evaluate_skipped"),
+    "health.degraded": ("health", "degraded"),
+    "health.baseline_ready": ("health", "baseline_ready"),
+    "health.updates_paused": ("health", "updates_paused"),
+    "health.hl_chi2": ("health", "hl_chi2"),
+    "health.hl_p_value": ("health", "hl_p_value"),
+    "health.psi": ("health", "psi"),
+    "health.ks": ("health", "ks"),
+    "health.window_auc": ("health", "window_auc"),
+    "health.window_loss": ("health", "window_loss"),
+    "health.delta_l2_mean": ("health", "delta_l2_mean"),
+    "health.delta_l2_max": ("health", "delta_l2_max"),
+    "health.freezes_window": ("health", "freezes_window"),
+}
+
+
 class ServingMetrics:
     """All instruments behind one registry; compound updates take the
     local lock so ratios stay coherent."""
+
+    #: the metric-surface parity contract (module constant, re-exported
+    #: on the class so embedding callers can introspect it)
+    SNAPSHOT_PATHS = SNAPSHOT_PATHS
 
     def __init__(self, latency_window: int = 8192,
                  registry: Optional[MetricsRegistry] = None):
@@ -84,14 +160,46 @@ class ServingMetrics:
         self._deltas = r.counter("online.deltas_published")
         self._delta_rows = r.counter("online.delta_rows")
         self._stale_deltas = r.counter("online.stale_deltas")
-        self._frozen_entities = r.counter("online.frozen_entities")
+        self._freezes = r.counter("online.freezes")
         self._solve_retries = r.counter("online.solve_retries")
+        self._publish_retries = r.counter("online.publish_retries")
         self._solve_failures = r.counter("online.solve_failures")
         self._publish_time = r.counter("online.publish_s")
         # per-entity feedback-to-publish latency (enqueue of an entity's
         # OLDEST pending observation -> its row live in the scorer tables)
         self._f2p = r.histogram("online.feedback_to_publish_s",
                                 reservoir=latency_window)
+        # updater vitals that used to stop at OnlineUpdater.stats(): the
+        # service installs a probe and BOTH render paths refresh these
+        # gauges from it, so a scrape and a JSON snapshot always agree
+        # (the same refresh discipline as serve.model_age_s)
+        self._online_frozen = r.gauge("online.frozen_entities")
+        self._online_cycle_age = r.gauge("online.last_cycle_age_s")
+        self._online_alive = r.gauge("online.updater_alive")
+        self._online_probe = None
+        # -- model-health tier (photon_ml_tpu/health/) ----------------------
+        # instruments exist whether or not a HealthMonitor is armed (all
+        # zeros disarmed — the same contract as the online.* family)
+        self._health_label_windows = r.counter("health.label_windows")
+        self._health_score_windows = r.counter("health.score_windows")
+        self._health_labels = r.counter("health.labels")
+        self._health_breaches = r.counter("health.breaches")
+        self._health_trips = r.counter("health.gate_trips")
+        self._health_recoveries = r.counter("health.recoveries")
+        self._health_rollbacks = r.counter("health.rollbacks")
+        self._health_skipped = r.counter("health.evaluate_skipped")
+        self._health_degraded = r.gauge("health.degraded")
+        self._health_baseline_ready = r.gauge("health.baseline_ready")
+        self._health_paused = r.gauge("health.updates_paused")
+        self._health_hl_chi2 = r.gauge("health.hl_chi2")
+        self._health_hl_p = r.gauge("health.hl_p_value")
+        self._health_psi = r.gauge("health.psi")
+        self._health_ks = r.gauge("health.ks")
+        self._health_auc = r.gauge("health.window_auc")
+        self._health_loss = r.gauge("health.window_loss")
+        self._health_delta_mean = r.gauge("health.delta_l2_mean")
+        self._health_delta_max = r.gauge("health.delta_l2_max")
+        self._health_freezes = r.gauge("health.freezes_window")
 
     # counter-value conveniences (tests and embedding callers read these
     # like the old plain-int attributes)
@@ -197,13 +305,73 @@ class ServingMetrics:
         self._stale_deltas.inc()
 
     def observe_frozen_entity(self, n: int = 1) -> None:
-        self._frozen_entities.inc(n)
+        self._freezes.inc(n)
 
     def observe_solve_retry(self) -> None:
         self._solve_retries.inc()
 
+    def observe_publish_retry(self) -> None:
+        self._publish_retries.inc()
+
     def observe_solve_failure(self) -> None:
         self._solve_failures.inc()
+
+    def set_online_probe(self, fn) -> None:
+        """`fn() -> {"frozen": int, "alive": bool, "paused": bool,
+        "last_cycle_age_s": float|None}` — the OnlineUpdater's live
+        vitals, refreshed on BOTH render paths (snapshot + prometheus)."""
+        with self._lock:
+            self._online_probe = fn
+
+    # -- model-health tier ---------------------------------------------------
+
+    @staticmethod
+    def _set_if(gauge, value) -> None:
+        """Gauges keep their last value across windows that could not
+        produce one (single-class AUC, no deltas published)."""
+        if value is not None:
+            gauge.set(round(float(value), 6))
+
+    def observe_health_label_window(self, *, rows: int, hl_chi2, hl_p,
+                                    auc, loss, delta_l2_mean, delta_l2_max,
+                                    freezes: int, breaches: int) -> None:
+        with self._lock:
+            self._health_label_windows.inc()
+            self._health_labels.inc(rows)
+            self._health_breaches.inc(breaches)
+        self._set_if(self._health_hl_chi2, hl_chi2)
+        self._set_if(self._health_hl_p, hl_p)
+        self._set_if(self._health_auc, auc)
+        self._set_if(self._health_loss, loss)
+        self._set_if(self._health_delta_mean, delta_l2_mean)
+        self._set_if(self._health_delta_max, delta_l2_max)
+        self._health_freezes.set(int(freezes))
+
+    def observe_health_score_window(self, *, rows: int, psi, ks,
+                                    breaches: int) -> None:
+        with self._lock:
+            self._health_score_windows.inc()
+            self._health_breaches.inc(breaches)
+        self._set_if(self._health_psi, psi)
+        self._set_if(self._health_ks, ks)
+
+    def observe_health_status(self, *, degraded: bool, paused: bool,
+                              baseline_ready: bool) -> None:
+        self._health_degraded.set(int(degraded))
+        self._health_paused.set(int(paused))
+        self._health_baseline_ready.set(int(baseline_ready))
+
+    def observe_health_trip(self) -> None:
+        self._health_trips.inc()
+
+    def observe_health_recovery(self) -> None:
+        self._health_recoveries.inc()
+
+    def observe_health_rollback(self) -> None:
+        self._health_rollbacks.inc()
+
+    def observe_health_skipped(self) -> None:
+        self._health_skipped.inc()
 
     def _refresh_model_age(self) -> float:
         with self._lock:
@@ -211,9 +379,27 @@ class ServingMetrics:
         self._model_age.set(round(age, 3))
         return age
 
+    def _refresh_online_gauges(self) -> None:
+        """Pull the updater's live vitals into the gauges (both render
+        paths call this, so neither surface can go stale alone).
+        `last_cycle_age_s` is -1 until the first completed cycle."""
+        with self._lock:
+            probe = self._online_probe
+        if probe is None:
+            return
+        try:
+            st = probe()
+        except Exception:
+            return  # a dying updater must not take the scrape down
+        self._online_frozen.set(int(st.get("frozen", 0)))
+        self._online_alive.set(int(bool(st.get("alive", False))))
+        age = st.get("last_cycle_age_s")
+        self._online_cycle_age.set(-1.0 if age is None else round(age, 3))
+
     # -- reporting ---------------------------------------------------------
 
     def snapshot(self, model_version: Optional[str] = None) -> Dict:
+        self._refresh_online_gauges()
         with self._lock:
             batches = self._batches.value
             bucket_rows = self._bucket_rows.value
@@ -258,6 +444,7 @@ class ServingMetrics:
             out["latency_ms"] = None
         out["model_age_s"] = round(self._refresh_model_age(), 3)
         out["online"] = self._online_snapshot()
+        out["health"] = self._health_snapshot()
         if model_version is not None:
             out["model_version"] = model_version
         return out
@@ -282,8 +469,12 @@ class ServingMetrics:
             "deltas_published": deltas,
             "delta_rows": self._delta_rows.value,
             "stale_deltas": self._stale_deltas.value,
-            "frozen_entities": self._frozen_entities.value,
+            "freezes": self._freezes.value,
+            "frozen_entities": self._online_frozen.value,
+            "last_cycle_age_s": self._online_cycle_age.value,
+            "updater_alive": self._online_alive.value,
             "solve_retries": self._solve_retries.value,
+            "publish_retries": self._publish_retries.value,
             "solve_failures": self._solve_failures.value,
             "mean_publish_ms": round(
                 1e3 * self._publish_time.value / deltas, 3)
@@ -300,10 +491,38 @@ class ServingMetrics:
             out["feedback_to_publish_ms"] = None
         return out
 
+    def _health_snapshot(self) -> Dict:
+        """The model-health tier's state (all zeros when no HealthMonitor
+        is armed — the instruments exist either way)."""
+        return {
+            "label_windows": self._health_label_windows.value,
+            "score_windows": self._health_score_windows.value,
+            "labels": self._health_labels.value,
+            "breaches": self._health_breaches.value,
+            "gate_trips": self._health_trips.value,
+            "recoveries": self._health_recoveries.value,
+            "rollbacks": self._health_rollbacks.value,
+            "evaluate_skipped": self._health_skipped.value,
+            "degraded": self._health_degraded.value,
+            "baseline_ready": self._health_baseline_ready.value,
+            "updates_paused": self._health_paused.value,
+            "hl_chi2": self._health_hl_chi2.value,
+            "hl_p_value": self._health_hl_p.value,
+            "psi": self._health_psi.value,
+            "ks": self._health_ks.value,
+            "window_auc": self._health_auc.value,
+            "window_loss": self._health_loss.value,
+            "delta_l2_mean": self._health_delta_mean.value,
+            "delta_l2_max": self._health_delta_max.value,
+            "freezes_window": self._health_freezes.value,
+        }
+
     def prometheus(self, model_version: Optional[str] = None) -> str:
         """Prometheus text exposition of every serving instrument
-        (including the online tier's staleness gauge and the
-        feedback-to-publish latency summary)."""
+        (including the online tier's staleness + updater-vitals gauges and
+        the health.* family) — refreshed-at-render gauges get the SAME
+        refresh here as on the JSON surface."""
         self._refresh_model_age()
+        self._refresh_online_gauges()
         info = {"model_version": model_version} if model_version else None
         return prometheus_text(self.registry, extra_info=info)

@@ -23,6 +23,7 @@ had absorbed before being swapped out.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -33,6 +34,8 @@ from photon_ml_tpu.serving.scorer import CompiledScorer
 from photon_ml_tpu.utils import faults, locktrace
 from photon_ml_tpu.utils.events import (EventEmitter, ModelDeltaEvent,
                                         ModelSwapEvent)
+
+logger = logging.getLogger("photon_ml_tpu")
 
 
 class StaleDeltaError(RuntimeError):
@@ -68,6 +71,22 @@ class ModelRegistry:
         self._delta_log: deque = deque()
         self._delta_log_truncated = False
         self._delta_seq = 0
+        self._swap_hooks: list = []
+
+    def add_swap_hook(self, fn: Callable[[str, str], None]) -> None:
+        """`fn(version, action)` runs after every FULL-model change —
+        install ("swap") and full-model rollback ("rollback"), never a
+        row-level delta — outside the registry lock.  The health monitor
+        registers here to snapshot its drift baseline per install."""
+        self._swap_hooks.append(fn)
+
+    def _run_swap_hooks(self, version: str, action: str) -> None:
+        for fn in list(self._swap_hooks):
+            try:
+                fn(version, action)
+            except Exception:  # a broken observer must not block a swap
+                logger.exception("swap hook %r failed for %s %r",
+                                 fn, action, version)
 
     @property
     def scorer(self) -> CompiledScorer:
@@ -129,6 +148,7 @@ class ModelRegistry:
             time=time.time(), version=version,
             previous_version=None if previous is None else previous[0],
             action="swap", warmup_s=getattr(scorer, "warmup_s", 0.0)))
+        self._run_swap_hooks(version, "swap")
         return version
 
     def load_async(self, version_dir: str,
@@ -244,4 +264,8 @@ class ModelRegistry:
             previous_version=(None if rolled_from is None
                               else rolled_from[0]),
             action="delta_rollback" if reverted else "rollback"))
+        if not reverted:
+            # delta rollback keeps the same full-model version live: the
+            # health baseline is carried, exactly like a delta publish
+            self._run_swap_hooks(version, "rollback")
         return version

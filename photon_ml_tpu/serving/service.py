@@ -45,18 +45,32 @@ class ScoringService:
     def __init__(self, model_dir: Optional[str] = None,
                  model=None, config: Optional[ServingConfig] = None,
                  emitter: Optional[EventEmitter] = None,
-                 updates=None, start_updater: bool = True):
+                 updates=None, start_updater: bool = True,
+                 health=None):
         """`updates` (an online.OnlineUpdateConfig) enables the online
         learning tier: `feedback()` accepts labeled observations and a
         background OnlineUpdater re-solves ONLY the touched entities'
         random-effect subproblems, publishing row-level delta swaps into
         the live scorer.  `start_updater=False` keeps the updater manual
-        (tests/bench drive `service.updater.run_once()` themselves)."""
+        (tests/bench drive `service.updater.run_once()` themselves).
+
+        `health` (a health.HealthConfig) arms the model-health monitor:
+        streaming calibration over feedback-joined labels, score-
+        distribution drift vs a per-install baseline, and gates that
+        flip /healthz to degraded, pause the updater, and optionally
+        trigger the delta-aware rollback (cli.serve --health-config)."""
         if (model_dir is None) == (model is None):
             raise ValueError("pass exactly one of model_dir / model")
         self.config = config or ServingConfig()
         self.emitter = emitter
         self.metrics = ServingMetrics(self.config.latency_window)
+        self.health = None
+        if health is not None:
+            from photon_ml_tpu.health import HealthConfig, HealthMonitor
+            if not isinstance(health, HealthConfig):
+                raise TypeError("health must be a health.HealthConfig, got "
+                                f"{type(health).__name__}")
+            self.health = HealthMonitor(health, metrics=self.metrics)
         cfg = self.config
 
         def factory(version_dir, version):
@@ -72,6 +86,10 @@ class ScoringService:
 
         self.registry = ModelRegistry(factory, emitter=emitter,
                                       metrics=self.metrics)
+        if self.health is not None:
+            # registered BEFORE the initial load so the first install
+            # stamps the version and starts the drift baseline
+            self.registry.add_swap_hook(self.health.on_model_event)
         self.registry.load(model_dir, version=None if model_dir else "inline@1")
         self._batcher = MicroBatcher(
             self._score_batch,
@@ -84,9 +102,14 @@ class ScoringService:
             from photon_ml_tpu.online import OnlineUpdater
             self.updater = OnlineUpdater(self.registry,
                                          metrics=self.metrics,
-                                         config=updates, emitter=emitter)
+                                         config=updates, emitter=emitter,
+                                         health=self.health)
+            self.metrics.set_online_probe(self.updater.probe)
             if start_updater:
                 self.updater.start()
+        if self.health is not None:
+            self.health.bind(registry=self.registry, updater=self.updater,
+                             task_type=self.registry.scorer.model.task_type)
         self._closed = False
         # one telemetry.snapshot() returns serving state alongside the
         # training/streaming registries (latest-constructed service wins
@@ -134,6 +157,9 @@ class ScoringService:
                             version=scorer.version):
             result = scorer.score(features, ids)
         score_s = time.monotonic() - t0
+        if self.health is not None:  # faults.fire()-style disarm: one
+            # None check when health is off, one histogram add per BATCH on
+            self.health.observe_scores(result.scores)
         self.metrics.observe_batch(
             rows=result.num_rows, bucket_rows=sum(result.buckets),
             num_requests=num_requests, entity_hits=result.entity_hits,
@@ -162,13 +188,46 @@ class ScoringService:
                 "online updates are not enabled — construct the service "
                 "with updates=OnlineUpdateConfig() (or cli.serve "
                 "--enable-updates)")
-        return self.updater.submit(features, ids, labels, weights=weights,
-                                   offsets=offsets, event_ids=event_ids)
+        out = self.updater.submit(features, ids, labels, weights=weights,
+                                  offsets=offsets, event_ids=event_ids)
+        if self.health is not None:
+            # the delayed-label join: score the admitted batch once through
+            # the warmed bucket programs and feed calibration/loss/AUC
+            self.health.observe_feedback(
+                self.registry.scorer, features, ids, labels,
+                weights=weights, offsets=offsets)
+        return out
 
     def version_vector(self) -> Dict:
         """(full-model version, delta seq): the staleness identity of the
         live scorer."""
         return self.registry.version_vector()
+
+    def healthz(self) -> Dict:
+        """The /healthz payload: overall status (degraded when a health
+        gate is tripped), the version vector, updater vitals (thread
+        liveness, last-cycle age, frozen entities, pause state), and the
+        per-gate health verdict."""
+        out = {
+            "status": "ok",
+            "model_version": self.model_version,
+            "version_vector": self.version_vector(),
+            "updates_enabled": self.updater is not None,
+            "health_enabled": self.health is not None,
+        }
+        if self.updater is not None:
+            probe = self.updater.probe()
+            probe["pending_rows"] = self.updater.buffer.pending_rows
+            age = probe["last_cycle_age_s"]
+            if age is not None:
+                probe["last_cycle_age_s"] = round(age, 3)
+            out["updater"] = probe
+        if self.health is not None:
+            verdict = self.health.verdict()
+            out["health"] = verdict
+            if verdict["status"] == "degraded":
+                out["status"] = "degraded"
+        return out
 
     # -- model lifecycle ---------------------------------------------------
 

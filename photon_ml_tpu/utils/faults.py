@@ -64,6 +64,12 @@ Injection sites currently threaded (ctx keys in parentheses):
                     live scorer (registry.apply_delta call site);
                     transient faults retry, fatal ones drop the delta and
                     re-enqueue the feedback for the next cycle
+  health.evaluate   model-health window evaluation (kind)
+                    (health/monitor.py, kind = "drift" | "labels");
+                    transient faults SKIP the window (counted in
+                    health.evaluate_skipped — a dropped verdict, never a
+                    dropped serving request), fatal ones propagate to the
+                    thread that closed the window
 """
 from __future__ import annotations
 
@@ -97,6 +103,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "solve.poison": ("coordinate", "iteration"),
     "online.solve": ("coordinate",),
     "online.publish": ("coordinate",),
+    "health.evaluate": ("kind",),
 }
 
 

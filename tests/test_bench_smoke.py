@@ -289,6 +289,62 @@ def test_online_smoke(tmp_path):
     assert latency["under_updates"]["errors"] == 0
 
 
+def test_health_smoke(tmp_path):
+    """bench.py --health --smoke end-to-end in tier-1 (ISSUE 11
+    satellite): the model-health harness — streaming calibration windows,
+    drift baselines, gate trips on injected label-flip and covariate
+    shift, pause + delta rollback, the armed/disarmed compile gate —
+    cannot rot without failing the normal test run.  The p99 gate is a
+    smoke SIGNAL here (shared-core CI); the full bench run enforces it
+    hard."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_health.json"
+    result = bench.health_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    # zero false alarms across the stationary leg (deltas flowing live)
+    stationary = next(e for e in detail["entries"]
+                      if e["name"] == "health_stationary")
+    assert stationary["gate_trips"] == 0
+    assert stationary["deltas_published"] > 0
+    assert stationary["status"] == "ok"
+    # injected label flip: calibration gate trips within <= 3 windows,
+    # updater pauses, the pending deltas roll back bit-exact
+    flip = next(e for e in detail["entries"]
+                if e["name"] == "health_label_flip")
+    assert flip["windows_to_trip"] is not None
+    assert flip["windows_to_trip"] <= 3
+    assert flip["status"] == "degraded" and flip["updater_paused"]
+    assert flip["deltas_published_while_paused"] == 0
+    assert flip["rollback_restored_pre_delta_rows"] is True
+    # injected covariate shift: a drift gate trips within <= 3 windows
+    covariate = next(e for e in detail["entries"]
+                     if e["name"] == "health_covariate_shift")
+    assert covariate["windows_to_trip"] is not None
+    assert covariate["windows_to_trip"] <= 3
+    assert covariate["tripped_gates"]
+    # zero fresh traces armed AND disarmed, with windows closing inside
+    # the counted region
+    traces = next(e for e in detail["entries"]
+                  if e["name"] == "health_steady_state_traces")
+    assert traces["armed"]["fresh_traces"] == 0
+    assert traces["disarmed"]["fresh_traces"] == 0
+    assert traces["armed"]["label_windows"] >= 3
+    # the latency leg ran without errors on both sides (ratio is gated
+    # by the full bench, not here)
+    latency = next(e for e in detail["entries"]
+                   if e["name"] == "health_latency")
+    assert latency["disarmed"]["errors"] == 0
+    assert latency["armed"]["errors"] == 0
+    assert latency["armed"]["score_windows"] > 0
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a
