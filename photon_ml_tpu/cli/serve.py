@@ -37,9 +37,41 @@ HTTP mode (default) — a dependency-free stdlib server:
                                               is tripped (status
                                               "degraded")
 
-  429 = Overloaded (queue full), 504 = DeadlineExceeded, 400 = bad request.
+  429 = Overloaded (queue full; POST /feedback rejections carry a
+  Retry-After header derived from the online updater's observed drain
+  rate), 504 = DeadlineExceeded, 400 = bad request.
   SIGUSR1 dumps a metrics snapshot to stderr; --metrics-interval dumps one
   periodically.
+
+Graceful drain: SIGTERM/SIGINT stops accepting new requests, finishes the
+in-flight micro-batches, flushes the FeedbackBuffer through the online
+updater (when updates are enabled), closes everything cleanly, prints a
+final {"drained": true, ...} line and exits 0.  A second signal aborts
+immediately (utils.faults.GracefulPreemption semantics).
+
+Fleet modes (photon_ml_tpu/fleet/ — see COMPONENTS.md "Replicated
+serving"):
+
+  --replica --replication-log DIR --replica-state DIR
+      run as a fleet replica: join (snapshot bootstrap + log-tail replay
+      + delta-program warmup), then keep converged with the publisher's
+      model state by tailing the replication log.  /healthz returns 503
+      until ready (and while draining/failed), so a front or Kubernetes
+      probe holds traffic.  Followers refuse /swap, /rollback and
+      /feedback (model state enters the fleet through the log only).
+      Extra endpoints: GET /fleet/audit (version vector + per-table
+      sha256 — the bit-identical convergence check), POST /fleet/drain.
+  --replica --publish [--enable-updates]
+      the PUBLISHER replica: every registry mutation (swap, delta,
+      rollback) is appended to the replication log in mutation order;
+      the online updater's delta stream replicates live.
+  --front --replica-url URL [--replica-url URL ...]
+      model-free routing front: /score + /predict round-robin over READY
+      replicas (health-probed, failover, hedged tail latency, bounded
+      in-flight -> 429), /feedback//swap//rollback proxied to the
+      publisher replica, GET /fleet/audit fans out to every replica,
+      POST /fleet/drain {"replica": URL} drains one replica out of
+      rotation.
 
 Burst mode (--burst DATA.npz) — drive a synthetic client burst from a
 GameDataset through the full micro-batching pipeline in-process, print the
@@ -59,9 +91,9 @@ import time
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="photon-ml-tpu-serve")
-    p.add_argument("--model-dir", required=True,
+    p.add_argument("--model-dir", default=None,
                    help="GAME model directory (any layout models/io.py "
-                        "reads)")
+                        "reads); required except in --front mode")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080,
                    help="HTTP port (0 = ephemeral; the bound port is "
@@ -103,6 +135,47 @@ def build_parser() -> argparse.ArgumentParser:
                         "calibration + drift gates flip /healthz to "
                         "degraded, pause the online updater, and per "
                         "rollback_on trigger the delta-aware rollback")
+    p.add_argument("--max-delta-log", type=int, default=4096,
+                   help="delta undo-log bound; overflow drops the oldest "
+                        "records LOUDLY and rollback degrades to a "
+                        "full-model swap (serve.rollback_degraded)")
+    # -- fleet: replica mode ------------------------------------------------
+    p.add_argument("--replica", action="store_true",
+                   help="run as a fleet replica: join from the "
+                        "replication log, stay converged, 503 until "
+                        "ready (requires --replication-log and "
+                        "--replica-state)")
+    p.add_argument("--publish", action="store_true",
+                   help="this replica is the PUBLISHER: its registry "
+                        "mutations (swaps, deltas, rollbacks) append to "
+                        "the replication log in mutation order")
+    p.add_argument("--replication-log", default=None, metavar="DIR",
+                   help="replication log directory (shared filesystem "
+                        "between publisher and replicas)")
+    p.add_argument("--replica-state", default=None, metavar="DIR",
+                   help="this replica's durable state dir (applied.json "
+                        "— the crash/catch-up resume point)")
+    p.add_argument("--replica-poll-ms", type=float, default=50.0,
+                   help="log tail poll period of the replica apply loop")
+    # -- fleet: front mode --------------------------------------------------
+    p.add_argument("--front", action="store_true",
+                   help="run the model-free routing front over "
+                        "--replica-url replicas")
+    p.add_argument("--replica-url", action="append", default=[],
+                   help="replica base URL (repeatable); the first is the "
+                        "publisher unless --publisher-url is given")
+    p.add_argument("--publisher-url", default=None,
+                   help="which replica accepts /feedback,/swap,/rollback")
+    p.add_argument("--probe-interval-ms", type=float, default=250.0,
+                   help="front: /healthz probe period per replica")
+    p.add_argument("--hedge-ms", type=float, default=250.0,
+                   help="front: hedge a duplicate request after this "
+                        "long pending")
+    p.add_argument("--front-timeout-ms", type=float, default=10_000.0,
+                   help="front: per-attempt request timeout")
+    p.add_argument("--max-inflight", type=int, default=256,
+                   help="front: concurrently routed requests before "
+                        "shedding (429)")
     p.add_argument("--event-listener", action="append", default=[],
                    help="dotted EventListener class path (repeatable); "
                         "receives ScoringBatchEvent/ModelSwapEvent")
@@ -132,7 +205,8 @@ def _build_service(args):
         max_queue=args.max_queue,
         min_bucket=args.min_bucket,
         default_timeout_s=(None if args.default_timeout_ms is None
-                           else args.default_timeout_ms / 1e3))
+                           else args.default_timeout_ms / 1e3),
+        max_delta_log=args.max_delta_log)
     updates = None
     if args.enable_updates:
         from photon_ml_tpu.online import OnlineUpdateConfig
@@ -147,8 +221,13 @@ def _build_service(args):
         from photon_ml_tpu.cli.train import _load_json_arg
         from photon_ml_tpu.health import HealthConfig
         health = HealthConfig.from_dict(_load_json_arg(args.health_config))
+    # publisher mode starts the updater only AFTER the replication
+    # publish hook is attached (main wires that), so no delta can ever
+    # land unreplicated
+    start_updater = not (args.replica and args.publish)
     return ScoringService(model_dir=args.model_dir, config=cfg,
-                          emitter=emitter, updates=updates, health=health)
+                          emitter=emitter, updates=updates, health=health,
+                          start_updater=start_updater)
 
 
 def _dump_metrics(service, stream=sys.stderr):
@@ -218,12 +297,19 @@ def run_burst(service, data_path: str, request_rows: int, threads: int,
 
 # -- HTTP mode -------------------------------------------------------------
 
-def _make_http_server(service, host: str, port: int):
+def _make_http_server(service, host: str, port: int, replica=None,
+                      publisher=None):
+    """`replica` (fleet.Replica) and `publisher` (fleet.FleetPublisher)
+    extend the handler with the fleet endpoints and gate the model-state
+    routes: followers refuse /swap, /rollback and /feedback — replicated
+    model state enters through the log, never through a follower."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     import numpy as np
 
     from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
+
+    follower = replica is not None and publisher is None
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -231,11 +317,13 @@ def _make_http_server(service, host: str, port: int):
         def log_message(self, fmt, *a):  # requests are metered, not logged
             pass
 
-        def _reply(self, code: int, payload: dict):
+        def _reply(self, code: int, payload: dict, headers=None):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -264,10 +352,38 @@ def _make_http_server(service, host: str, port: int):
                 self._reply(200, service.metrics_snapshot())
             elif self.path == "/healthz":
                 payload = service.healthz()
+                if publisher is not None:
+                    fleet = publisher.status()
+                    # the publisher IS the source of truth: its applied
+                    # seq is the log head (what replica lag measures
+                    # against)
+                    head = publisher.head_seq()
+                    fleet.update({"ready": fleet["failed"] is None,
+                                  "applied_seq": head, "head_seq": head,
+                                  "lag_seq": 0})
+                    payload["fleet"] = fleet
+                    if fleet["failed"] is not None:
+                        payload["status"] = "degraded"
+                elif replica is not None:
+                    # joining / draining / failed -> 503 so the front
+                    # (or a stock Kubernetes probe) holds traffic until
+                    # the replica is converged and warm
+                    payload["fleet"] = replica.status()
+                    if not replica.healthy():
+                        payload["status"] = "degraded"
                 # degraded -> 503 so a stock load balancer / Kubernetes
                 # probe takes the replica out without parsing the body
                 self._reply(200 if payload["status"] == "ok" else 503,
                             payload)
+            elif self.path == "/fleet/audit":
+                if replica is not None:
+                    self._reply(200, replica.audit())
+                else:
+                    audit = service.audit()
+                    if publisher is not None:
+                        audit.update({"role": "publisher",
+                                      "applied_seq": publisher.head_seq()})
+                    self._reply(200, audit)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -293,6 +409,12 @@ def _make_http_server(service, host: str, port: int):
                     self._reply(200, {key: np.asarray(out).tolist(),
                                       "model_version": service.model_version})
                 elif self.path == "/feedback":
+                    if follower:
+                        return self._reply(403, {
+                            "error": "this is a follower replica: "
+                                     "feedback goes to the publisher "
+                                     "(model state enters the fleet "
+                                     "through the replication log)"})
                     if service.updater is None:
                         return self._reply(400, {
                             "error": "online updates are not enabled "
@@ -311,17 +433,41 @@ def _make_http_server(service, host: str, port: int):
                     out["version_vector"] = service.version_vector()
                     self._reply(202, out)
                 elif self.path == "/swap":
+                    if follower:
+                        return self._reply(403, {
+                            "error": "this is a follower replica: swap "
+                                     "on the publisher (it replicates "
+                                     "through the log)"})
                     if not req.get("model_dir"):
                         return self._reply(400,
                                            {"error": "model_dir required"})
                     v = service.swap(req["model_dir"], req.get("version"))
                     self._reply(200, {"version": v})
                 elif self.path == "/rollback":
+                    if follower:
+                        return self._reply(403, {
+                            "error": "this is a follower replica: roll "
+                                     "back on the publisher (it "
+                                     "replicates through the log)"})
                     self._reply(200, {"version": service.rollback()})
+                elif self.path == "/fleet/drain" and replica is not None:
+                    self._reply(200, replica.drain())
                 else:
                     self._reply(404, {"error": f"unknown path {self.path}"})
             except Overloaded as e:
-                self._reply(429, {"error": str(e)})
+                headers = None
+                retry_after = getattr(e, "retry_after_s", None)
+                if retry_after is not None:
+                    # integer delta-seconds per RFC 9110; derived from
+                    # the updater's observed feedback drain rate
+                    headers = {"Retry-After":
+                               str(max(1, int(round(retry_after))))}
+                    self._reply(429, {"error": str(e),
+                                      "retry_after_s":
+                                          round(retry_after, 3)},
+                                headers)
+                else:
+                    self._reply(429, {"error": str(e)})
             except DeadlineExceeded as e:
                 self._reply(504, {"error": str(e)})
             except (ValueError, KeyError) as e:
@@ -332,8 +478,173 @@ def _make_http_server(service, host: str, port: int):
     return ThreadingHTTPServer((host, port), Handler)
 
 
+def _make_front_server(front, host: str, port: int):
+    """The routing front's HTTP server: /score + /predict fan out over
+    ready replicas, model-state routes proxy to the publisher, fleet
+    introspection aggregates the replicas."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from photon_ml_tpu.fleet import NoReadyReplica
+    from photon_ml_tpu.serving import Overloaded
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            pass
+
+        def _reply(self, code: int, payload: dict, headers=None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_text(self, code: int, body: str, content_type: str):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return {}
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._reply_text(
+                    200, front.prometheus_metrics(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/metrics.json":
+                self._reply(200, front.metrics_snapshot())
+            elif self.path == "/healthz":
+                status = front.status()
+                ok = status["ready_replicas"] > 0
+                status["status"] = "ok" if ok else "degraded"
+                self._reply(200 if ok else 503, status)
+            elif self.path == "/fleet/audit":
+                self._reply(200, front.audit())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                req = self._body()
+            except ValueError as e:
+                return self._reply(400, {"error": f"bad JSON: {e}"})
+            try:
+                if self.path in ("/score", "/predict"):
+                    timeout = req.get("timeout_ms")
+                    timeout = None if timeout is None else timeout / 1e3
+                    status, payload = front.route(self.path, req,
+                                                  timeout=timeout)
+                    self._reply(status, payload)
+                elif self.path in ("/feedback", "/swap", "/rollback"):
+                    status, payload, headers = front.route_publisher(
+                        "POST", self.path, req)
+                    self._reply(status, payload, headers)
+                elif self.path == "/fleet/drain":
+                    if not req.get("replica"):
+                        return self._reply(
+                            400, {"error": "replica URL required"})
+                    self._reply(200, front.drain(req["replica"]))
+                else:
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+            except Overloaded as e:
+                self._reply(429, {"error": str(e)})
+            except NoReadyReplica as e:
+                self._reply(503, {"error": str(e)})
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def _serve_with_graceful_drain(httpd, poll_interval: float = 0.1):
+    """Run the HTTP loop until SIGTERM/SIGINT requests a graceful drain
+    (or the server dies).  Returns (drained, aborted): on drain the
+    server has STOPPED ACCEPTING and in-flight handlers have finished; a
+    second signal aborts immediately (aborted=True — skip the flush)."""
+    from photon_ml_tpu.utils import faults
+
+    worker = threading.Thread(target=httpd.serve_forever,
+                              kwargs={"poll_interval": poll_interval},
+                              daemon=True, name="photon-serve-http")
+    drained = aborted = False
+    with faults.GracefulPreemption():
+        worker.start()
+        try:
+            while worker.is_alive():
+                if faults.preemption_requested():
+                    drained = True
+                    break
+                time.sleep(poll_interval)
+        except KeyboardInterrupt:  # second signal: the operator means it
+            drained, aborted = True, True
+    # stop accepting; ThreadingHTTPServer.shutdown returns after the
+    # serve loop exits, and in-flight handler threads complete their
+    # responses before the process moves on to flushing state
+    httpd.shutdown()
+    worker.join(timeout=10.0)
+    return drained, aborted
+
+
+def _run_front(args) -> int:
+    from photon_ml_tpu.fleet import Front, FrontConfig
+    front = Front(
+        args.replica_url, publisher_url=args.publisher_url,
+        config=FrontConfig(
+            probe_interval_s=args.probe_interval_ms / 1e3,
+            hedge_after_s=args.hedge_ms / 1e3,
+            request_timeout_s=args.front_timeout_ms / 1e3,
+            max_inflight=args.max_inflight))
+    front.probe_once()  # populate readiness before the first request
+    httpd = _make_front_server(front, args.host, args.port)
+    print(json.dumps({
+        "serving": f"http://{args.host}:{httpd.server_address[1]}",
+        "mode": "front",
+        "replicas": args.replica_url,
+        "publisher": args.publisher_url or args.replica_url[0],
+        "endpoints": ["/score", "/predict", "/feedback", "/metrics",
+                      "/metrics.json", "/swap", "/rollback", "/healthz",
+                      "/fleet/audit", "/fleet/drain"],
+    }), flush=True)
+    try:
+        drained, aborted = _serve_with_graceful_drain(httpd)
+    finally:
+        httpd.server_close()
+        front.close()
+    if drained:
+        print(json.dumps({"drained": True, "aborted": aborted,
+                          "mode": "front"}), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.front:
+        if not args.replica_url:
+            raise SystemExit("--front requires at least one --replica-url")
+        return _run_front(args)
+    if not args.model_dir:
+        raise SystemExit("--model-dir is required (except in --front mode)")
+    if args.replica and not (args.replication_log and args.replica_state):
+        raise SystemExit("--replica requires --replication-log and "
+                         "--replica-state")
+    if args.enable_updates and args.replica and not args.publish:
+        raise SystemExit("a follower replica cannot run the online "
+                         "updater (--enable-updates needs --publish): "
+                         "model state enters the fleet through the "
+                         "replication log")
     from photon_ml_tpu.utils.jax_cache import enable_persistent_cache
     enable_persistent_cache()
     t0 = time.perf_counter()
@@ -349,27 +660,63 @@ def main(argv=None) -> int:
         print(json.dumps(result))
         return 1 if result["failed_requests"] else 0
 
-    httpd = _make_http_server(service, args.host, args.port)
+    replica = publisher = None
+    join_info = None
+    if args.replica:
+        from photon_ml_tpu.fleet import (FleetPublisher, Replica,
+                                         ReplicaConfig, ReplicationLog)
+        log = ReplicationLog(args.replication_log)
+        if args.publish:
+            publisher = FleetPublisher(service, log,
+                                       model_dir=args.model_dir)
+            if service.updater is not None:
+                # started HERE, after the publish hook attached: no delta
+                # may ever land unreplicated
+                service.updater.start()
+        else:
+            replica = Replica(
+                service, log, args.replica_state,
+                ReplicaConfig(poll_interval_s=args.replica_poll_ms / 1e3))
+            join_info = replica.join()
+            replica.start()
+
+    httpd = _make_http_server(service, args.host, args.port,
+                              replica=replica, publisher=publisher)
     _install_metrics_hooks(service, args.metrics_interval)
     print(json.dumps({
         "serving": f"http://{args.host}:{httpd.server_address[1]}",
+        "mode": ("publisher" if publisher is not None else
+                 "replica" if replica is not None else "standalone"),
         "model_dir": args.model_dir,
         "model_version": service.model_version,
         "model_load_s": round(load_s, 3),
         "buckets": service.registry.scorer.bucket_sizes(),
         "updates_enabled": service.updater is not None,
         "health_enabled": service.health is not None,
+        "join": join_info,
         "endpoints": ["/score", "/predict", "/feedback", "/metrics",
-                      "/metrics.json", "/swap", "/rollback", "/healthz"],
+                      "/metrics.json", "/swap", "/rollback", "/healthz"]
+        + (["/fleet/audit", "/fleet/drain"] if args.replica else []),
     }), flush=True)
     try:
-        httpd.serve_forever(poll_interval=0.2)
-    except KeyboardInterrupt:
-        pass
+        drained, aborted = _serve_with_graceful_drain(httpd)
     finally:
         httpd.server_close()
-        service.close()
-        _dump_metrics(service)
+    flushed = None
+    if drained and not aborted and service.updater is not None \
+            and not service.updater.paused:
+        # the drain contract: everything the intake admitted either
+        # publishes (and replicates) or is accounted before exit
+        flushed = service.updater.flush()
+    if replica is not None:
+        replica.close()
+    service.close()
+    _dump_metrics(service)
+    if drained:
+        print(json.dumps({
+            "drained": True, "aborted": aborted,
+            "feedback_flushed": flushed,
+            "version_vector": service.version_vector()}), flush=True)
     return 0
 
 

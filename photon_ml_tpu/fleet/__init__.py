@@ -1,0 +1,22 @@
+"""Replicated serving fleet: replication log, replica runtime, front.
+
+One process with one CompiledScorer is not "millions of users" — and it
+is a single point of failure.  This package scales the serving tier out:
+a durable append-only ReplicationLog carries every model-state change
+(full swaps, version-vectored ModelDeltas, delta-aware rollbacks) from
+ONE publisher to N replica processes, each of which replays the log
+through its own ModelRegistry and converges to bit-identical tables
+(audited by version vector + per-table sha256); a health-probing Front
+routes scoring traffic across the ready replicas with failover, hedging,
+draining and explicit backpressure.  See COMPONENTS.md "Replicated
+serving" for the log format and the convergence argument.
+"""
+from photon_ml_tpu.fleet.front import (Front, FrontConfig,  # noqa: F401
+                                       NoReadyReplica, ReplicaHandle)
+from photon_ml_tpu.fleet.replica import (FleetPublisher,  # noqa: F401
+                                         Replica, ReplicaConfig,
+                                         ReplicaError)
+from photon_ml_tpu.fleet.replog import (ReplicationLog,  # noqa: F401
+                                        ReplicationLogError, decode_array,
+                                        delta_from_record, encode_array,
+                                        record_for_event)

@@ -1,0 +1,500 @@
+"""Failover front: routes scoring traffic across N replica processes.
+
+The front is model-free — it never loads a scorer.  It holds a handle per
+replica URL (the serve HTTP protocol IS the replica protocol) and:
+
+  * PROBES   a background thread GETs each replica's /healthz every
+             `probe_interval_s`; an un-ready replica (503: joining,
+             draining, failed, health-gate degraded — PR 11's verdicts)
+             leaves the rotation after `unhealthy_after` consecutive
+             failures and re-enters after `healthy_after` successes.
+             Probe payloads also carry each replica's applied seq, which
+             feeds the `fleet.front_max_lag_seq` gauge.
+  * ROUTES   /score and /predict round-robin over READY replicas;
+             transport errors and 5xx responses fail over to the next
+             replica (bounded by `max_attempts`, counted per failover);
+             POST /feedback, /swap and /rollback go to the PUBLISHER
+             replica only — model state changes enter the fleet through
+             the replication log, never through a follower.
+  * HEDGES   a scoring attempt still pending after `hedge_after_s` fires
+             a duplicate at a different ready replica; first response
+             wins, the loser is abandoned (bounded tail latency without
+             giving up on the slow replica's in-flight work).
+  * SHEDS    beyond `max_inflight` concurrently routed requests the
+             front degrades to Overloaded (HTTP 429) instead of queueing
+             without bound — queue collapse upstream of the replicas is
+             strictly worse than explicit backpressure.
+  * DRAINS   `drain(url)` stops routing to a replica, tells it to drain
+             (its own /healthz flips 503 for any other front), waits for
+             in-flight requests to finish, then detaches it.
+
+The front's routing metrics live on its OWN MetricsRegistry (the
+ServingMetrics fleet.* family is the replica-side surface): request /
+failover / hedge / retry / shed counters plus ready-replica and lag
+gauges, exposed as Prometheus text at the front's /metrics.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from http.client import HTTPConnection
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry.export import prometheus_text
+from photon_ml_tpu.telemetry.metrics import MetricsRegistry
+from photon_ml_tpu.serving.batcher import Overloaded, ServingError
+from photon_ml_tpu.utils import locktrace
+
+import dataclasses
+import logging
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+class NoReadyReplica(ServingError):
+    """Every replica is out of rotation (joining, draining, failed, or
+    unreachable) — the front cannot place the request."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontConfig:
+    """Routing knobs (cli.serve --front maps 1:1)."""
+
+    probe_interval_s: float = 0.25  # /healthz probe period per replica
+    probe_timeout_s: float = 2.0
+    unhealthy_after: int = 2        # consecutive probe failures -> out
+    healthy_after: int = 1          # consecutive successes -> back in
+    request_timeout_s: float = 10.0
+    hedge_after_s: float = 0.25     # pending this long -> hedge a twin
+    max_attempts: int = 3           # total sends per request (incl. hedges)
+    max_inflight: int = 256         # routed concurrently before shedding
+
+
+class ReplicaHandle:
+    """One replica's routing state (all fields guarded by Front._lock)."""
+
+    def __init__(self, url: str, publisher: bool = False):
+        self.url = url.rstrip("/")
+        parts = urlsplit(self.url)
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port or 80
+        self.publisher = publisher
+        self.ready = False
+        self.fails = 0
+        self.successes = 0
+        self.draining = False
+        self.detached = False
+        self.inflight = 0
+        self.applied_seq: Optional[int] = None
+        self.last_error: Optional[str] = None
+
+    def state(self) -> Dict[str, object]:
+        return {"url": self.url, "publisher": self.publisher,
+                "ready": self.ready, "draining": self.draining,
+                "detached": self.detached, "inflight": self.inflight,
+                "applied_seq": self.applied_seq,
+                "last_error": self.last_error}
+
+
+class Front:
+    def __init__(self, replica_urls: List[str],
+                 publisher_url: Optional[str] = None,
+                 config: FrontConfig = FrontConfig(),
+                 start_probes: bool = True):
+        """`publisher_url` names the replica that accepts model-state
+        changes (/feedback, /swap, /rollback); defaults to the first URL.
+        `start_probes=False` keeps probing manual (`probe_once()`) for
+        tests and the bench."""
+        if not replica_urls:
+            raise ValueError("a front needs at least one replica URL")
+        self.config = config
+        self._lock = locktrace.tracked(threading.Lock(), "Front._lock")
+        publisher_url = (publisher_url or replica_urls[0]).rstrip("/")
+        self._handles = [ReplicaHandle(u, publisher=(u.rstrip("/") ==
+                                                     publisher_url))
+                         for u in replica_urls]
+        self._rr = 0                             # photonlint: guarded-by=_lock
+        self._inflight_total = 0                 # photonlint: guarded-by=_lock
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self._m_requests = r.counter("fleet.front_requests")
+        self._m_failovers = r.counter("fleet.front_failovers")
+        self._m_hedges = r.counter("fleet.front_hedges")
+        self._m_retries = r.counter("fleet.front_retries")
+        self._m_shed = r.counter("fleet.front_shed")
+        self._m_errors = r.counter("fleet.front_errors")
+        self._m_probe_failures = r.counter("fleet.front_probe_failures")
+        self._m_ready = r.gauge("fleet.front_ready_replicas")
+        self._m_max_lag = r.gauge("fleet.front_max_lag_seq")
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(8, min(config.max_inflight, 64)),
+            thread_name_prefix="photon-front")
+        self._closed = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None  # photonlint: guarded-by=_lock
+        if start_probes:
+            self.start_probes()
+
+    # -- probing -------------------------------------------------------------
+
+    def probe_once(self) -> Dict[str, bool]:
+        """Probe every attached replica once; returns {url: ready}."""
+        cfg = self.config
+        results: Dict[str, bool] = {}
+        with self._lock:
+            handles = [h for h in self._handles if not h.detached]
+        for h in handles:
+            ok, payload = False, None
+            try:
+                status, body = self._send(h, "GET", "/healthz", None,
+                                          cfg.probe_timeout_s)
+                payload = json.loads(body) if body else {}
+                ok = status == 200
+                err = None if ok else f"healthz {status}"
+            except Exception as e:
+                err = f"{type(e).__name__}: {e}"
+            with self._lock:
+                was_ready = h.ready
+                if ok:
+                    h.successes += 1
+                    h.fails = 0
+                    if h.successes >= cfg.healthy_after:
+                        h.ready = not h.draining
+                    h.last_error = None
+                    fleet = (payload or {}).get("fleet") or {}
+                    if fleet.get("applied_seq") is not None:
+                        h.applied_seq = int(fleet["applied_seq"])
+                else:
+                    h.fails += 1
+                    h.successes = 0
+                    h.last_error = err
+                    if h.fails >= cfg.unhealthy_after:
+                        h.ready = False
+                now_ready = h.ready
+                results[h.url] = now_ready
+            if not ok:
+                self._m_probe_failures.inc()
+            if was_ready != now_ready:
+                telemetry.event("front_replica_health", url=h.url,
+                                ready=str(now_ready), error=str(err))
+                logger.warning("front: replica %s -> %s%s", h.url,
+                               "READY" if now_ready else "OUT",
+                               f" ({err})" if err else "")
+        self._refresh_gauges()
+        return results
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            ready = [h for h in self._handles
+                     if h.ready and not h.detached]
+            seqs = [h.applied_seq for h in self._handles
+                    if not h.detached and h.applied_seq is not None]
+        self._m_ready.set(len(ready))
+        if seqs:
+            self._m_max_lag.set(max(seqs) - min(seqs))
+
+    def start_probes(self) -> None:
+        with self._lock:
+            if self._probe_thread is not None:
+                return
+            thread = threading.Thread(target=self._probe_loop, daemon=True,
+                                      name="photon-front-probe")
+            self._probe_thread = thread
+        thread.start()
+
+    def _probe_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                self.probe_once()
+            except Exception as e:  # the probe loop must never die
+                logger.exception("front probe cycle failed: %s", e)
+            self._closed.wait(timeout=self.config.probe_interval_s)
+
+    # -- transport -----------------------------------------------------------
+
+    @staticmethod
+    def _send(h: ReplicaHandle, method: str, path: str,
+              body: Optional[bytes], timeout: float
+              ) -> Tuple[int, bytes]:
+        conn = HTTPConnection(h.host, h.port, timeout=timeout)
+        try:
+            headers = {"Content-Type": "application/json"}
+            if body is not None:
+                headers["Content-Length"] = str(len(body))
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    # -- routing -------------------------------------------------------------
+
+    def _pick(self, exclude=()) -> Optional[ReplicaHandle]:
+        with self._lock:
+            n = len(self._handles)
+            for i in range(n):
+                h = self._handles[(self._rr + i) % n]
+                if h.ready and not h.draining and not h.detached \
+                        and h.url not in exclude:
+                    self._rr = (self._rr + i + 1) % n
+                    h.inflight += 1
+                    return h
+        return None
+
+    def _release(self, h: ReplicaHandle) -> None:
+        with self._lock:
+            h.inflight = max(h.inflight - 1, 0)
+
+    def _mark_failure(self, h: ReplicaHandle, err: str) -> None:
+        with self._lock:
+            h.fails += 1
+            h.successes = 0
+            h.last_error = err
+            if h.fails >= self.config.unhealthy_after:
+                h.ready = False
+
+    def route(self, path: str, payload: dict,
+              timeout: Optional[float] = None) -> Tuple[int, dict]:
+        """Route one scoring request (POST /score | /predict): bounded
+        in-flight, failover across ready replicas, hedging on a slow
+        attempt.  Returns (HTTP status, decoded payload)."""
+        cfg = self.config
+        with self._lock:
+            if self._inflight_total >= cfg.max_inflight:
+                shed = True
+            else:
+                shed = False
+                self._inflight_total += 1
+        if shed:
+            self._m_shed.inc()
+            raise Overloaded(
+                f"front at capacity ({cfg.max_inflight} requests in "
+                "flight); retry after the replicas drain")
+        self._m_requests.inc()
+        body = json.dumps(payload).encode()
+        timeout = timeout if timeout is not None else cfg.request_timeout_s
+        try:
+            return self._route_attempts(path, body, timeout)
+        finally:
+            with self._lock:
+                self._inflight_total -= 1
+
+    def _route_attempts(self, path: str, body: bytes,
+                        timeout: float) -> Tuple[int, dict]:
+        cfg = self.config
+        tried: set = set()
+        pending: Dict[object, ReplicaHandle] = {}
+        sends = 0
+        last_client_error: Optional[Tuple[int, dict]] = None
+
+        def launch() -> bool:
+            nonlocal sends
+            h = self._pick(exclude=tried)
+            if h is None:
+                return False
+            tried.add(h.url)
+            sends += 1
+            fut = self._pool.submit(self._send, h, "POST", path, body,
+                                    timeout)
+            pending[fut] = h
+            return True
+
+        if not launch():
+            self._m_errors.inc()
+            raise NoReadyReplica(
+                "no ready replica to route to (all joining, draining, "
+                "failed, or unreachable)")
+        hedged = False
+        try:
+            while pending:
+                wait_s = (cfg.hedge_after_s
+                          if not hedged and sends < cfg.max_attempts
+                          else timeout + 1.0)
+                done, _ = wait(list(pending), timeout=wait_s,
+                               return_when=FIRST_COMPLETED)
+                if not done:
+                    # the attempt is slow, not dead: hedge a duplicate at
+                    # a different replica, first response wins
+                    hedged = True
+                    if launch():
+                        self._m_hedges.inc()
+                        telemetry.event("front_hedged", path=path)
+                    continue
+                for fut in done:
+                    h = pending.pop(fut)
+                    self._release(h)
+                    try:
+                        status, raw = fut.result()
+                    except Exception as e:
+                        self._mark_failure(h, f"{type(e).__name__}: {e}")
+                        self._m_failovers.inc()
+                        continue
+                    if status >= 500:
+                        self._mark_failure(h, f"http {status}")
+                        self._m_failovers.inc()
+                        continue
+                    try:
+                        decoded = json.loads(raw) if raw else {}
+                    except ValueError:
+                        decoded = {"error": "undecodable replica response"}
+                    if status == 429:
+                        # replica backpressure: one chance elsewhere,
+                        # else propagate the shed to the client
+                        last_client_error = (status, decoded)
+                        self._m_retries.inc()
+                        continue
+                    return status, decoded
+                if not pending and sends < cfg.max_attempts:
+                    if launch():
+                        self._m_retries.inc()
+                        continue
+            if last_client_error is not None:
+                return last_client_error
+            self._m_errors.inc()
+            raise NoReadyReplica(
+                f"request failed on every reachable replica "
+                f"({sends} attempt(s): {sorted(tried)})")
+        finally:
+            for fut, h in pending.items():
+                # abandoned hedges: release accounting; the send itself
+                # finishes (or times out) on the pool thread
+                fut.add_done_callback(
+                    lambda _f, _h=h: self._release(_h))
+
+    def publisher_handle(self) -> Optional[ReplicaHandle]:
+        with self._lock:
+            for h in self._handles:
+                if h.publisher and not h.detached:
+                    return h
+        return None
+
+    def route_publisher(self, method: str, path: str,
+                        payload: Optional[dict] = None,
+                        timeout: Optional[float] = None
+                        ) -> Tuple[int, dict, Dict[str, str]]:
+        """Route a model-state request (feedback/swap/rollback) to the
+        publisher replica; returns (status, payload, passthrough
+        headers) — Retry-After from the publisher's backpressure rides
+        through to the client."""
+        h = self.publisher_handle()
+        if h is None:
+            raise NoReadyReplica("no publisher replica attached")
+        body = None if payload is None else json.dumps(payload).encode()
+        timeout = (timeout if timeout is not None
+                   else self.config.request_timeout_s)
+        conn = HTTPConnection(h.host, h.port, timeout=timeout)
+        try:
+            headers = {"Content-Type": "application/json"}
+            if body is not None:
+                headers["Content-Length"] = str(len(body))
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            passthrough = {}
+            retry_after = resp.getheader("Retry-After")
+            if retry_after:
+                passthrough["Retry-After"] = retry_after
+            try:
+                decoded = json.loads(raw) if raw else {}
+            except ValueError:
+                decoded = {"error": "undecodable replica response"}
+            return resp.status, decoded, passthrough
+        except (ConnectionError, OSError) as e:
+            self._mark_failure(h, f"{type(e).__name__}: {e}")
+            self._m_errors.inc()
+            raise NoReadyReplica(
+                f"publisher {h.url} unreachable: {e}") from e
+        finally:
+            conn.close()
+
+    # -- drain / audit / status ----------------------------------------------
+
+    def drain(self, url: str, timeout: float = 30.0) -> Dict[str, object]:
+        """Take one replica out: stop routing, ask it to drain (its own
+        /healthz flips 503), wait for in-flight to finish, detach."""
+        url = url.rstrip("/")
+        with self._lock:
+            handle = next((h for h in self._handles if h.url == url), None)
+            if handle is None:
+                raise ValueError(f"no attached replica at {url!r}")
+            handle.draining = True
+            handle.ready = False
+        try:
+            self._send(handle, "POST", "/fleet/drain", b"{}",
+                       self.config.probe_timeout_s)
+        except Exception as e:  # drain is best-effort on the replica side
+            logger.warning("front: drain request to %s failed: %s", url, e)
+        waited = 0.0
+        step = 0.05
+        while waited < timeout:
+            with self._lock:
+                if handle.inflight == 0:
+                    break
+            self._closed.wait(timeout=step)
+            waited += step
+        with self._lock:
+            handle.detached = True
+            remaining = handle.inflight
+        self._refresh_gauges()
+        telemetry.event("front_replica_drained", url=url,
+                        inflight_left=str(remaining))
+        logger.info("front: replica %s drained and detached "
+                    "(waited %.2fs, %d in flight left)", url, waited,
+                    remaining)
+        return {"url": url, "detached": True, "inflight_left": remaining}
+
+    def attach(self, url: str) -> None:
+        """(Re-)attach a replica URL; it enters rotation once probes see
+        it ready."""
+        url = url.rstrip("/")
+        with self._lock:
+            for h in self._handles:
+                if h.url == url:
+                    h.detached = False
+                    h.draining = False
+                    h.fails = h.successes = 0
+                    h.ready = False
+                    return
+            self._handles.append(ReplicaHandle(url))
+
+    def audit(self) -> Dict[str, object]:
+        """Fan /fleet/audit out to every attached replica: the fleet
+        convergence check in one call."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            handles = [h for h in self._handles if not h.detached]
+        for h in handles:
+            try:
+                status, raw = self._send(h, "GET", "/fleet/audit", None,
+                                         self.config.probe_timeout_s)
+                out[h.url] = (json.loads(raw) if status == 200
+                              else {"error": f"http {status}"})
+            except Exception as e:
+                out[h.url] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            replicas = [h.state() for h in self._handles]
+            ready = sum(1 for h in self._handles
+                        if h.ready and not h.detached)
+        return {"role": "front", "ready_replicas": ready,
+                "replicas": replicas}
+
+    def prometheus_metrics(self) -> str:
+        self._refresh_gauges()
+        return prometheus_text(self.registry)
+
+    def metrics_snapshot(self) -> Dict[str, object]:
+        self._refresh_gauges()
+        return self.registry.snapshot()
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._lock:
+            thread, self._probe_thread = self._probe_thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._pool.shutdown(wait=False, cancel_futures=True)

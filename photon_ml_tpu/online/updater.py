@@ -133,6 +133,7 @@ class OnlineUpdater:
         self._paused = False                              # photonlint: guarded-by=_state_lock
         self.pause_reason: Optional[str] = None           # photonlint: guarded-by=_state_lock
         self._last_cycle_at: Optional[float] = None       # photonlint: guarded-by=_state_lock
+        self._drain_rate: float = 0.0                     # photonlint: guarded-by=_state_lock
         self._wake = threading.Event()
         self._closed = threading.Event()
         self._jitter = random.Random(0xC0FFEE)
@@ -276,6 +277,7 @@ class OnlineUpdater:
         totals = {"entities": 0, "rows": 0, "deltas": 0}
         if self.paused:
             return totals
+        t0 = clock()
         scorer = self.registry.scorer  # ONE version for the whole cycle
         for lane, shard, re_type in scorer.updatable_coordinates():
             if self.buffer.pending_entities(lane) == 0:
@@ -291,8 +293,15 @@ class OnlineUpdater:
                 totals["entities"] += published["entities"]
                 totals["rows"] += published["rows"]
                 totals["deltas"] += 1
+        cycle_s = clock() - t0
         with self._state_lock:
             self._last_cycle_at = clock()
+            if totals["rows"] and cycle_s > 0:
+                # EMA of lane-rows drained per second: what the 429
+                # Retry-After derivation divides the backlog by
+                rate = totals["rows"] / cycle_s
+                self._drain_rate = (rate if self._drain_rate == 0.0 else
+                                    0.7 * self._drain_rate + 0.3 * rate)
         return totals
 
     def flush(self, max_cycles: int = 1000) -> Dict[str, int]:
@@ -336,6 +345,20 @@ class OnlineUpdater:
     def paused(self) -> bool:
         with self._state_lock:
             return self._paused
+
+    def retry_after_s(self) -> float:
+        """How long a 429'd feedback client should wait before retrying,
+        derived from the updater's observed drain rate: the pending
+        backlog divided by the EMA of lane-rows drained per second
+        (clamped to [interval_s, 30]).  Before the first drain there is
+        no rate yet — the poll interval is the honest floor."""
+        pending = self.buffer.pending_rows
+        with self._state_lock:
+            rate = self._drain_rate
+        if rate <= 0.0:
+            return max(self.config.interval_s, 0.05)
+        return float(min(max(pending / rate, self.config.interval_s, 0.05),
+                         30.0))
 
     def last_cycle_age_s(self) -> Optional[float]:
         """Seconds since the last completed update cycle (None before

@@ -55,6 +55,7 @@ SNAPSHOT_PATHS = {
     "serving.bucket_compiles": ("bucket_compiles",),
     "serving.swaps": ("swaps",),
     "serving.rollbacks": ("rollbacks",),
+    "serve.rollback_degraded": ("rollback_degraded",),
     "serving.requests_per_batch_sum": ("requests_per_batch",),
     "serving.queue_wait_s": ("mean_queue_wait_ms",),
     "serving.batch_score_s": ("mean_batch_score_ms",),
@@ -68,6 +69,7 @@ SNAPSHOT_PATHS = {
     "online.feedback_deduped": ("online", "deduped"),
     "online.feedback_coalesced": ("online", "coalesced"),
     "online.feedback_shed": ("online", "shed"),
+    "online.feedback_rejected": ("online", "feedback_rejected"),
     "online.update_cycles": ("online", "update_cycles"),
     "online.entities_updated": ("online", "entities_updated"),
     "online.rows_trained": ("online", "rows_trained"),
@@ -103,6 +105,12 @@ SNAPSHOT_PATHS = {
     "health.delta_l2_mean": ("health", "delta_l2_mean"),
     "health.delta_l2_max": ("health", "delta_l2_max"),
     "health.freezes_window": ("health", "freezes_window"),
+    "fleet.applied_seq": ("fleet", "applied_seq"),
+    "fleet.lag_seq": ("fleet", "lag_seq"),
+    "fleet.ready": ("fleet", "ready"),
+    "fleet.records_applied": ("fleet", "records_applied"),
+    "fleet.apply_retries": ("fleet", "apply_retries"),
+    "fleet.catchup_s": ("fleet", "catchup_s"),
 }
 
 
@@ -134,6 +142,7 @@ class ServingMetrics:
         self._bucket_compiles = r.counter("serving.bucket_compiles")
         self._swaps = r.counter("serving.swaps")
         self._rollbacks = r.counter("serving.rollbacks")
+        self._rollback_degraded = r.counter("serve.rollback_degraded")
         self._requests_per_batch_sum = r.counter(
             "serving.requests_per_batch_sum")
         self._queue_wait = r.counter("serving.queue_wait_s")
@@ -154,6 +163,7 @@ class ServingMetrics:
         self._feedback_deduped = r.counter("online.feedback_deduped")
         self._feedback_coalesced = r.counter("online.feedback_coalesced")
         self._feedback_shed = r.counter("online.feedback_shed")
+        self._feedback_rejected = r.counter("online.feedback_rejected")
         self._updates = r.counter("online.update_cycles")
         self._entities_updated = r.counter("online.entities_updated")
         self._rows_trained = r.counter("online.rows_trained")
@@ -200,6 +210,16 @@ class ServingMetrics:
         self._health_delta_mean = r.gauge("health.delta_l2_mean")
         self._health_delta_max = r.gauge("health.delta_l2_max")
         self._health_freezes = r.gauge("health.freezes_window")
+        # -- replicated-serving tier (photon_ml_tpu/fleet/) ------------------
+        # replica-side replication vitals (all zeros outside --replica
+        # mode — the same exists-either-way contract as online./health.*);
+        # the FRONT's routing counters live on its own registry, not here
+        self._fleet_applied_seq = r.gauge("fleet.applied_seq")
+        self._fleet_lag_seq = r.gauge("fleet.lag_seq")
+        self._fleet_ready = r.gauge("fleet.ready")
+        self._fleet_records = r.counter("fleet.records_applied")
+        self._fleet_apply_retries = r.counter("fleet.apply_retries")
+        self._fleet_catchup = r.gauge("fleet.catchup_s")
 
     # counter-value conveniences (tests and embedding callers read these
     # like the old plain-int attributes)
@@ -266,6 +286,11 @@ class ServingMetrics:
         with self._lock:
             self._last_model_change = time.monotonic()
 
+    def observe_rollback_degraded(self) -> None:
+        """A rollback could not restore exact pre-delta rows (undo-log
+        overflow) and fell back to a full-model swap."""
+        self._rollback_degraded.inc()
+
     # -- online-update tier -------------------------------------------------
 
     def observe_feedback(self, *, requests: int = 1, rows: int = 0,
@@ -283,6 +308,31 @@ class ServingMetrics:
 
     def observe_feedback_shed(self) -> None:
         self._feedback_shed.inc()
+
+    def observe_feedback_rejected(self) -> None:
+        """A whole feedback batch was rejected with backpressure (the
+        HTTP 429 + Retry-After path, counted at the service surface)."""
+        self._feedback_rejected.inc()
+
+    # -- replicated-serving tier ---------------------------------------------
+
+    def observe_replica_applied(self, *, applied_seq: int, lag_seq: int,
+                                records: int = 0) -> None:
+        """A replica apply cycle finished: refresh the replication
+        gauges and count the records that landed."""
+        self._fleet_applied_seq.set(int(applied_seq))
+        self._fleet_lag_seq.set(max(int(lag_seq), 0))
+        if records:
+            self._fleet_records.inc(records)
+
+    def observe_replica_ready(self, ready: bool,
+                              catchup_s: float = None) -> None:
+        self._fleet_ready.set(int(bool(ready)))
+        if catchup_s is not None:
+            self._fleet_catchup.set(round(float(catchup_s), 3))
+
+    def observe_replica_apply_retry(self) -> None:
+        self._fleet_apply_retries.inc()
 
     def observe_update_cycle(self, *, entities: int, rows: int) -> None:
         with self._lock:
@@ -424,6 +474,7 @@ class ServingMetrics:
                 "errors": self._errors.value,
                 "swaps": self._swaps.value,
                 "rollbacks": self._rollbacks.value,
+                "rollback_degraded": self._rollback_degraded.value,
                 "mean_queue_wait_ms": round(
                     1e3 * self._queue_wait.value / batches, 3)
                 if batches else None,
@@ -445,6 +496,7 @@ class ServingMetrics:
         out["model_age_s"] = round(self._refresh_model_age(), 3)
         out["online"] = self._online_snapshot()
         out["health"] = self._health_snapshot()
+        out["fleet"] = self._fleet_snapshot()
         if model_version is not None:
             out["model_version"] = model_version
         return out
@@ -463,6 +515,7 @@ class ServingMetrics:
             "deduped": self._feedback_deduped.value,
             "coalesced": self._feedback_coalesced.value,
             "shed": self._feedback_shed.value,
+            "feedback_rejected": self._feedback_rejected.value,
             "update_cycles": self._updates.value,
             "entities_updated": self._entities_updated.value,
             "rows_trained": self._rows_trained.value,
@@ -515,6 +568,18 @@ class ServingMetrics:
             "delta_l2_mean": self._health_delta_mean.value,
             "delta_l2_max": self._health_delta_max.value,
             "freezes_window": self._health_freezes.value,
+        }
+
+    def _fleet_snapshot(self) -> Dict:
+        """The replicated-serving tier's replica-side state (all zeros
+        outside --replica mode — the instruments exist either way)."""
+        return {
+            "applied_seq": self._fleet_applied_seq.value,
+            "lag_seq": self._fleet_lag_seq.value,
+            "ready": self._fleet_ready.value,
+            "records_applied": self._fleet_records.value,
+            "apply_retries": self._fleet_apply_retries.value,
+            "catchup_s": self._fleet_catchup.value,
         }
 
     def prometheus(self, model_version: Optional[str] = None) -> str:

@@ -30,6 +30,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, Optional, Tuple
 
+import numpy as np
+
 from photon_ml_tpu.serving.scorer import CompiledScorer
 from photon_ml_tpu.utils import faults, locktrace
 from photon_ml_tpu.utils.events import (EventEmitter, ModelDeltaEvent,
@@ -45,10 +47,14 @@ class StaleDeltaError(RuntimeError):
     against stale residual margins."""
 
 
-#: undo-log depth: deltas are a few KB each, so this bounds memory at a
-#: few MB while keeping hours of update history rollback-able.  When the
-#: log overflows, the OLDEST records drop and delta rollback refuses
-#: (partial restoration would not be the exact pre-delta state).
+#: default undo-log depth: deltas are a few KB each, so this bounds
+#: memory at a few MB while keeping hours of update history rollback-able.
+#: The bound is configurable (`ModelRegistry(max_delta_log=...)`,
+#: ServingConfig.max_delta_log, cli.serve --max-delta-log).  When the log
+#: overflows, the OLDEST records drop — LOUDLY (warning log + the
+#: serve.rollback_degraded counter when a rollback then has to fall back)
+#: — and `rollback()` DEGRADES to a full-model rollback, because partial
+#: delta restoration would not be the exact pre-delta state.
 MAX_DELTA_LOG = 4096
 
 
@@ -72,6 +78,34 @@ class ModelRegistry:
         self._delta_log_truncated = False
         self._delta_seq = 0
         self._swap_hooks: list = []
+        # ordered model-state change feed (the replication log's source):
+        # every mutation reserves a ticket UNDER the lock, hooks run
+        # OUTSIDE it with (ticket, event) so a publisher can restore the
+        # mutation order even when hook invocations race
+        self._publish_hooks: list = []
+        self._publish_ticket = 0                          # photonlint: guarded-by=_lock
+
+    def add_publish_hook(self, fn: Callable[[int, dict], None]) -> int:
+        """`fn(ticket, event)` runs after EVERY model-state change —
+        full-model install, row-level delta, delta-aware rollback,
+        full-model rollback — outside the registry lock.  Tickets are
+        assigned under the lock at mutation time, so sorting events by
+        ticket reconstructs the exact mutation order even when two hook
+        invocations race on different threads (fleet.FleetPublisher
+        relies on this to keep the replication log ordered).  Returns the
+        next ticket that will be assigned, so a publisher attaching to a
+        live registry knows where its event stream starts."""
+        with self._lock:
+            self._publish_hooks.append(fn)
+            return self._publish_ticket
+
+    def _run_publish_hooks(self, ticket: int, event: dict) -> None:
+        for fn in list(self._publish_hooks):
+            try:
+                fn(ticket, event)
+            except Exception:  # a broken publisher must not block serving
+                logger.exception("publish hook %r failed for ticket %d %r",
+                                 fn, ticket, event.get("kind"))
 
     def add_swap_hook(self, fn: Callable[[str, str], None]) -> None:
         """`fn(version, action)` runs after every FULL-model change —
@@ -120,11 +154,15 @@ class ModelRegistry:
                 base = os.path.basename(str(version_dir).rstrip("/"))
                 version = f"{base or 'model'}@{self._counter}"
         scorer = self._factory(version_dir, version)  # heavy, outside lock
-        return self.install(scorer, version)
+        return self.install(scorer, version, source_dir=version_dir)
 
-    def install(self, scorer: CompiledScorer, version: str) -> str:
+    def install(self, scorer: CompiledScorer, version: str,
+                source_dir: Optional[str] = None) -> str:
         """Atomically make an already-built scorer the live one (the tail
-        of `load`; also the path for swapping in an in-memory model)."""
+        of `load`; also the path for swapping in an in-memory model).
+        `source_dir` is the model directory the scorer was built from
+        (None for in-memory models) — the replication publisher records
+        it so replicas can replay the swap."""
         if not getattr(scorer, "warmed", True):
             scorer.warmup()
         # the scorer must carry the version it is installed under: delta
@@ -142,12 +180,18 @@ class ModelRegistry:
             self._delta_log.clear()
             self._delta_log_truncated = False
             self._delta_seq = 0
+            ticket = self._publish_ticket
+            self._publish_ticket += 1
         if self._metrics is not None:
             self._metrics.observe_swap()
         self._emit(ModelSwapEvent(
             time=time.time(), version=version,
             previous_version=None if previous is None else previous[0],
             action="swap", warmup_s=getattr(scorer, "warmup_s", 0.0)))
+        self._run_publish_hooks(ticket, {
+            "kind": "swap", "version": version,
+            "previous_version": None if previous is None else previous[0],
+            "source_dir": None if source_dir is None else str(source_dir)})
         self._run_swap_hooks(version, "swap")
         return version
 
@@ -194,10 +238,25 @@ class ModelRegistry:
             scorer.apply_delta(delta)
             self._delta_seq = delta.seq
             self._delta_log.append(delta)
-            if len(self._delta_log) > self._max_delta_log:
+            overflowed = len(self._delta_log) > self._max_delta_log
+            if overflowed:
                 self._delta_log.popleft()
+                first_overflow = not self._delta_log_truncated
                 self._delta_log_truncated = True
             pending = len(self._delta_log)
+            ticket = self._publish_ticket
+            self._publish_ticket += 1
+        if overflowed and first_overflow:
+            # LOUD, once per overflow episode: from here on an exact
+            # delta-aware rollback is impossible and rollback() will
+            # degrade to a full-model swap (serve.rollback_degraded)
+            logger.error(
+                "delta undo log overflowed its bound of %d: oldest "
+                "records dropped — delta-aware rollback DEGRADES to a "
+                "full-model rollback until the next install (raise "
+                "max_delta_log / --max-delta-log if exact rollback "
+                "across this much update history is required)",
+                self._max_delta_log)
         if self._metrics is not None:
             self._metrics.observe_delta(rows=delta.num_rows,
                                         publish_s=publish_s)
@@ -206,6 +265,8 @@ class ModelRegistry:
             coordinates={n: cd.num_rows
                          for n, cd in delta.coordinates.items()},
             num_rows=delta.num_rows, publish_s=publish_s))
+        self._run_publish_hooks(ticket, {"kind": "delta", "delta": delta,
+                                         "version": version})
         return {"version": version, "delta_seq": delta.seq,
                 "pending_deltas": pending}
 
@@ -232,16 +293,36 @@ class ModelRegistry:
         With pending deltas: restore the exact pre-delta rows (reverting
         newest-first, so rows touched by several deltas land back on their
         original values bit-exactly) and stay on the current full-model
-        version.  With none: swap back to the previous full model."""
+        version.  With none: swap back to the previous full model.  With a
+        TRUNCATED undo log (overflow dropped the oldest records): an exact
+        pre-delta restore is impossible, so the rollback DEGRADES to the
+        full-model path — loudly (error log + serve.rollback_degraded on
+        both metric surfaces)."""
+        degraded = False
         with self._lock:
-            if self._delta_log:
-                if self._delta_log_truncated:
+            if self._delta_log and self._delta_log_truncated:
+                if self._previous is None:
                     raise RuntimeError(
                         "delta undo log overflowed (oldest records "
-                        "dropped): an exact pre-delta restore is no "
-                        "longer possible — roll back by swapping a "
-                        "full model version instead")
+                        "dropped) and no previous full model exists: "
+                        "neither an exact pre-delta restore nor a "
+                        "full-model rollback is possible — swap in a "
+                        "known-good model version instead")
+                degraded = True
+                self._delta_log.clear()
+                self._delta_log_truncated = False
+            if self._delta_log:
                 version, scorer = self._current
+                # fold the restored row state (oldest delta's prior wins
+                # per row: that is the value the newest-first revert loop
+                # below lands on) for the replication publish hook
+                restored: dict = {}
+                for delta in self._delta_log:          # oldest first
+                    for lane, cd in delta.coordinates.items():
+                        lane_rows = restored.setdefault(lane, {})
+                        for r, p in zip(cd.rows.tolist(), cd.prior):
+                            if r not in lane_rows:
+                                lane_rows[r] = p
                 reverted = 0
                 while self._delta_log:
                     scorer.revert_delta(self._delta_log.pop())
@@ -256,7 +337,19 @@ class ModelRegistry:
                 self._current, self._previous = self._previous, rolled_from
                 version = self._current[0]
                 reverted = 0
+                restored = {}
                 self._delta_seq = self._current[1].delta_seq
+            ticket = self._publish_ticket
+            self._publish_ticket += 1
+        if degraded:
+            if self._metrics is not None:
+                self._metrics.observe_rollback_degraded()
+            logger.error(
+                "rollback DEGRADED to a full-model swap (-> %r): the "
+                "delta undo log had overflowed, so the exact pre-delta "
+                "rows are gone — the restored state is the previous "
+                "version AS LAST SERVED, not the pre-delta tables",
+                version)
         if self._metrics is not None:
             self._metrics.observe_swap(rollback=True)
         self._emit(ModelSwapEvent(
@@ -264,8 +357,47 @@ class ModelRegistry:
             previous_version=(None if rolled_from is None
                               else rolled_from[0]),
             action="delta_rollback" if reverted else "rollback"))
-        if not reverted:
+        if reverted:
+            self._run_publish_hooks(ticket, {
+                "kind": "delta_rollback", "version": version,
+                "to_delta_seq": 0,
+                "restored": {lane: (np.asarray(sorted(rows), np.int64),
+                                    np.stack([rows[r]
+                                              for r in sorted(rows)]))
+                             for lane, rows in restored.items()}})
+        else:
+            self._run_publish_hooks(ticket, {
+                "kind": "rollback", "version": version,
+                "previous_version": (None if rolled_from is None
+                                     else rolled_from[0]),
+                "degraded": degraded})
             # delta rollback keeps the same full-model version live: the
             # health baseline is carried, exactly like a delta publish
             self._run_swap_hooks(version, "rollback")
         return version
+
+    def replay_row_state(self, restored: dict, version: str,
+                         to_delta_seq: int) -> None:
+        """Replication replay primitive: scatter explicit row states into
+        the LIVE scorer and pin the delta seq — how a replica applies a
+        delta_rollback record (the restored rows ride in the record, so
+        even a snapshot-bootstrapped replica with no local undo history
+        converges bit-identically) and how a snapshot bootstrap lands its
+        folded rows.  `restored` maps lane -> (rows [k], values [k, d])."""
+        with self._lock:
+            if self._current is None:
+                raise RuntimeError("no model loaded")
+            if self._current[0] != version:
+                raise StaleDeltaError(
+                    f"row-state replay targets version {version!r} but "
+                    f"{self._current[0]!r} is live — the replicated "
+                    "record stream is out of order")
+            scorer = self._current[1]
+            for lane, (rows, values) in restored.items():
+                scorer.scatter_rows(lane, rows, values)
+            scorer.delta_seq = int(to_delta_seq)
+            self._delta_seq = int(to_delta_seq)
+            # the explicit row state replaces whatever per-delta undo
+            # history this registry held for the current version
+            self._delta_log.clear()
+            self._delta_log_truncated = False

@@ -39,6 +39,8 @@ class ServingConfig:
     min_bucket: int = 8             # smallest padded batch bucket
     default_timeout_s: Optional[float] = None  # per-request deadline
     latency_window: int = 8192      # latency ring for percentiles
+    max_delta_log: int = 4096       # delta undo-log bound (overflow ->
+                                    # rollback degrades to full-model)
 
 
 class ScoringService:
@@ -85,7 +87,8 @@ class ScoringService:
                 min_bucket=cfg.min_bucket, version=version)
 
         self.registry = ModelRegistry(factory, emitter=emitter,
-                                      metrics=self.metrics)
+                                      metrics=self.metrics,
+                                      max_delta_log=cfg.max_delta_log)
         if self.health is not None:
             # registered BEFORE the initial load so the first install
             # stamps the version and starts the drift baseline
@@ -188,8 +191,19 @@ class ScoringService:
                 "online updates are not enabled — construct the service "
                 "with updates=OnlineUpdateConfig() (or cli.serve "
                 "--enable-updates)")
-        out = self.updater.submit(features, ids, labels, weights=weights,
-                                  offsets=offsets, event_ids=event_ids)
+        from photon_ml_tpu.serving.batcher import Overloaded
+        try:
+            out = self.updater.submit(features, ids, labels,
+                                      weights=weights, offsets=offsets,
+                                      event_ids=event_ids)
+        except Overloaded as e:
+            # whole-batch rejection surfaced to the caller: count it on
+            # both metric surfaces and stamp the backpressure hint the
+            # HTTP layer turns into a Retry-After header (derived from
+            # the updater's observed drain rate)
+            self.metrics.observe_feedback_rejected()
+            e.retry_after_s = self.updater.retry_after_s()
+            raise
         if self.health is not None:
             # the delayed-label join: score the admitted batch once through
             # the warmed bucket programs and feed calibration/loss/AUC
@@ -202,6 +216,13 @@ class ScoringService:
         """(full-model version, delta seq): the staleness identity of the
         live scorer."""
         return self.registry.version_vector()
+
+    def audit(self) -> Dict:
+        """The fleet convergence audit: version vector + per-table sha256
+        of the live scorer's exact device bytes.  Two replicas whose
+        audits agree converged bit-identically (GET /fleet/audit)."""
+        return {"version_vector": self.version_vector(),
+                "table_hashes": self.registry.scorer.table_hashes()}
 
     def healthz(self) -> Dict:
         """The /healthz payload: overall status (degraded when a health

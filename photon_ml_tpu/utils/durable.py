@@ -77,6 +77,20 @@ def atomic_write_json(path: str, obj, indent: int = 2, fsync: bool = True,
                       fsync=fsync, before_replace=before_replace)
 
 
+def append_text(path: str, text: str, fsync: bool = True) -> None:
+    """Durable append for record logs (the replication log's segment
+    files).  Appends are not atomic the way replace is: a crash mid-append
+    leaves a TORN TAIL, which is why every appended record must carry its
+    own integrity check (fleet/replog.py checksums each line and truncates
+    a torn tail on read).  The fsync makes every record that DID append
+    completely survive the crash."""
+    with open(path, "a") as f:
+        f.write(text)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+
+
 def write_marker(path: str, fsync: bool = True) -> None:
     """Create an empty completion marker (`_SUCCESS`) durably: the marker
     must not become visible-and-durable before the data it vouches for,

@@ -70,6 +70,20 @@ Injection sites currently threaded (ctx keys in parentheses):
                     health.evaluate_skipped — a dropped verdict, never a
                     dropped serving request), fatal ones propagate to the
                     thread that closed the window
+  replog.append     replication-log record append   (kind)
+                    (fleet/replog.py, kind = record type); transient
+                    faults retry with the staging backoff discipline in
+                    the publisher, fatal ones surface to the publishing
+                    thread (the record never becomes visible to replicas)
+  replog.read       replication-log tail read       (segment)
+                    (fleet/replog.py); transient faults retry in the
+                    replica's poll loop, fatal ones mark the replica
+                    failed (/healthz degraded, front stops routing)
+  replica.apply     one replicated record applied   (kind)
+                    to a replica's live registry (fleet/replica.py);
+                    transient faults retry with backoff and the replica
+                    converges to the bit-identical table state, fatal
+                    ones mark the replica failed
 """
 from __future__ import annotations
 
@@ -104,6 +118,9 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "online.solve": ("coordinate",),
     "online.publish": ("coordinate",),
     "health.evaluate": ("kind",),
+    "replog.append": ("kind",),
+    "replog.read": ("segment",),
+    "replica.apply": ("kind",),
 }
 
 

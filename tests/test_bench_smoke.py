@@ -345,6 +345,63 @@ def test_health_smoke(tmp_path):
     assert latency["armed"]["score_windows"] > 0
 
 
+def test_fleet_smoke(tmp_path):
+    """bench.py --fleet --smoke end-to-end in tier-1 (ISSUE 12
+    satellite): the replicated-serving harness — log replay with zero
+    fresh traces, mid-stream rollback convergence, transient-fault
+    trajectory parity, and the subprocess crash/catch-up leg with a real
+    SIGKILL — cannot rot without failing the normal test run.  The
+    1->2-replica throughput-scaling gate is a smoke SIGNAL here
+    (shared-core CI; on a single-core host it is measured and reported
+    ungated); the full bench run enforces it hard on multi-core hosts."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_fleet.json"
+    result = bench.fleet_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    # (d) zero fresh traces on the replica during steady-state replay
+    traces = next(e for e in detail["entries"]
+                  if e["name"] == "fleet_replay_traces")
+    assert traces["fresh_traces_replay"] == 0
+    assert traces["records_applied"] >= traces["steady_rounds"]
+    assert traces["converged"] is True
+    # (b) a mid-stream rollback converges identically on every replica
+    rollback = next(e for e in detail["entries"]
+                    if e["name"] == "fleet_rollback_convergence")
+    assert rollback["rollback_ok"] is True
+    assert rollback["publisher_restored_pre_delta_rows"] is True
+    assert rollback["deltas_rolled_back"] >= 1
+    # (e) injected transient replog/replica faults absorbed with
+    # exact-trajectory parity vs the fault-free run
+    parity = next(e for e in detail["entries"]
+                  if e["name"] == "fleet_fault_parity")
+    assert parity["fault_parity_ok"] is True
+    assert parity["faults_fired"] >= 4
+    assert parity["fault_free_vv"] == parity["faulted_vv"]
+    # (a) SIGKILLed follower restarts from durable state and the whole
+    # fleet reports bit-identical version vectors + table hashes
+    crash = next(e for e in detail["entries"]
+                 if e["name"] == "fleet_crash_catchup")
+    assert crash["killed_returncode"] not in (0, 1)   # actually SIGKILLed
+    assert crash["rejoined_ready"] is True
+    assert crash["bit_identical"] is True
+    assert crash["rows_scored"] > 0 and crash["feedback_rows"] > 0
+    assert crash["deltas_published"] > 0
+    # (c) both scaling phases served their full stream error-free (the
+    # ratio is the full bench's hard gate on multi-core hosts)
+    scaling = next(e for e in detail["entries"]
+                   if e["name"] == "fleet_scaling")
+    assert scaling["one_replica"]["errors"] == 0
+    assert scaling["two_replicas"]["errors"] == 0
+    assert scaling["throughput_ratio"] > 0
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a
