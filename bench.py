@@ -3821,19 +3821,25 @@ def _fleet_spawn(args, env_extra=None):
     return proc, info["serving"], info
 
 
-def _fleet_http(url, path, body=None, timeout=15.0):
+def _fleet_http(url, path, body=None, timeout=15.0, headers=None):
     import urllib.error
     import urllib.request
     data = None if body is None else json.dumps(body).encode()
     req = urllib.request.Request(
         url + path, data=data,
         method="POST" if data is not None else "GET",
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, json.loads(resp.read())
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read())
+
+
+def _fleet_http_text(url, path, timeout=15.0) -> str:
+    import urllib.request
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
 
 
 def _fleet_wait_healthy(url, timeout=150.0):
@@ -4213,6 +4219,459 @@ def fleet_bench(out_path="BENCH_fleet.json", smoke=False, max_wall=None):
 
 
 # --------------------------------------------------------------------------
+# --fleetobs: fleet-wide observability (telemetry/distributed + flight)
+# --------------------------------------------------------------------------
+
+def _fleetobs_wait(predicate, timeout_s=60.0, step_s=0.2):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        try:
+            if predicate():
+                return True
+        except Exception:
+            pass
+        time.sleep(step_s)
+    return False
+
+
+def _fleetobs_fleet_entry(smoke: bool, tmp: str) -> dict:
+    """One live fleet session (front + publisher + follower processes,
+    every process tracing to its own run log with the flight recorder
+    armed), three gate families:
+
+      (a) TRACE MERGE — client-stamped X-Photon-Trace ids on /score and
+          /feedback requests come back from `merge_run_logs` as ONE
+          connected tree each; the feedback tree crosses front ->
+          publisher -> online update -> replication record -> follower
+          apply; children stay inside their parents after clock-probe
+          alignment.
+      (b) FEDERATED METRICS — the front's /metrics exposes per-replica
+          instance-labelled series and probe-derived per-replica lag
+          that is 0 when converged, > 0 while the SIGKILLed follower is
+          down (the publisher keeps appending), and back to 0 after the
+          restarted follower catches up.
+      (c) FLIGHT RECORDER — the front marking the killed follower
+          unhealthy dumps its own ring AND broadcasts the trigger, so
+          bundles with the SAME trigger id from every live process are
+          on disk, each covering the kill window.
+    """
+    import signal as _signal
+
+    from photon_ml_tpu.telemetry.distributed import (TRACE_HEADER,
+                                                     merge_run_logs)
+
+    root = os.path.join(tmp, "obsfleet")
+    mdir = _fleet_save_model(root, seed=131, E=200)
+    log_dir = os.path.join(root, "replog")
+    logdir = os.path.join(root, "runlogs")
+    flightdir = os.path.join(root, "flight")
+    os.makedirs(logdir, exist_ok=True)
+    common = ["--model-dir", mdir, "--port", "0", "--max-batch", "64",
+              "--min-bucket", "4", "--replication-log", log_dir,
+              "--flight-dir", flightdir]
+
+    def runlog(name):
+        return os.path.join(logdir, name + ".jsonl")
+
+    pub_proc, pub_url, _ = _fleet_spawn(
+        common + ["--replica", "--publish", "--enable-updates",
+                  "--update-interval-ms", "10",
+                  "--replica-state", os.path.join(root, "pub"),
+                  "--run-log", runlog("pub")])
+    f0_proc, f0_url, _ = _fleet_spawn(
+        common + ["--replica", "--replica-poll-ms", "20",
+                  "--replica-state", os.path.join(root, "f0"),
+                  "--run-log", runlog("f0")])
+    assert _fleet_wait_healthy(pub_url) and _fleet_wait_healthy(f0_url), \
+        "fleet not healthy"
+    front_proc, front_url, _ = _fleet_spawn(
+        ["--front", "--replica-url", pub_url, "--replica-url", f0_url,
+         "--port", "0", "--probe-interval-ms", "100",
+         "--run-log", runlog("front"), "--flight-dir", flightdir])
+    assert _fleet_wait_healthy(front_url), "front not healthy"
+
+    rng = np.random.default_rng(137)
+    n_score = 6 if smoke else 16
+    score_ids = [f"{k:016x}" for k in range(1, n_score + 1)]
+    for rid in score_ids:
+        k = 2
+        body = {"features": {
+            "global": rng.normal(size=(k, 16)).tolist(),
+            "per_user": rng.normal(size=(k, 8)).tolist()},
+            "ids": {"userId": [f"u{rng.integers(0, 200)}"
+                               for _ in range(k)]}}
+        status, _ = _fleet_http(front_url, "/score", body,
+                                headers={TRACE_HEADER: rid})
+        assert status == 200, f"score http {status}"
+    fb_rid = "feedf10f" * 2
+
+    def feedback(rid=None, n=16):
+        body = {"features": {
+            "global": rng.normal(size=(n, 16)).tolist(),
+            "per_user": rng.normal(size=(n, 8)).tolist()},
+            "ids": {"userId": [f"u{rng.integers(0, 200)}"
+                               for _ in range(n)]},
+            "labels": (rng.uniform(size=n) < 0.5).astype(float).tolist()}
+        return _fleet_http(front_url, "/feedback", body,
+                           headers={TRACE_HEADER: rid} if rid else None)
+
+    status, _ = feedback(fb_rid)
+    assert status == 202, f"feedback http {status}"
+
+    def front_lag(url):
+        _, fed = _fleet_http(front_url, "/metrics.json")
+        return (fed.get("fleet", {}).get("replicas", {})
+                .get(url, {}))
+
+    # converged: the follower applied the delta and reports zero lag
+    converged = _fleetobs_wait(
+        lambda: front_lag(f0_url).get("lag_records") == 0
+        and front_lag(f0_url).get("applied_seq", 0) >= 2)
+    fed_text_converged = _fleet_http_text(front_url, "/metrics")
+    lag_at_converged = front_lag(f0_url)
+
+    # -- kill the follower; the publisher keeps advancing ------------------
+    f0_proc.send_signal(_signal.SIGKILL)
+    f0_proc.wait(timeout=10)
+    killed_rc = f0_proc.returncode
+    kill_wall = time.time()
+    for _ in range(2):
+        feedback()
+    # the front notices (probe failures) and the probe-derived lag for
+    # the dead follower goes positive against the advancing head
+    lagged = _fleetobs_wait(
+        lambda: (front_lag(f0_url).get("ready") == 0
+                 and (front_lag(f0_url).get("lag_records") or 0) > 0))
+    lag_while_down = front_lag(f0_url)
+
+    # flight bundles: the front's replica.unhealthy trigger fans out —
+    # front + publisher bundles share ONE trigger id
+    def unhealthy_bundles():
+        out = []
+        if not os.path.isdir(flightdir):
+            return out
+        for name in os.listdir(flightdir):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(flightdir, name)) as f:
+                    b = json.load(f)
+            except ValueError:
+                continue
+            if b.get("reason") == "replica.unhealthy":
+                out.append(b)
+        return out
+
+    def correlated():
+        by_id = {}
+        for b in unhealthy_bundles():
+            by_id.setdefault(b["trigger_id"], set()).add(b["proc"])
+        return any(len(procs) >= 2 for procs in by_id.values())
+
+    flight_correlated = _fleetobs_wait(correlated, timeout_s=30.0)
+    bundles = unhealthy_bundles()
+    bundle_procs = sorted({b["proc"] for b in bundles})
+    # each bundle's ring window must cover the moments before the kill
+    windows_cover = bool(bundles) and all(
+        b.get("window_s") and b["window_s"][0] <= kill_wall + 5.0
+        and b["window_s"][1] >= kill_wall - 60.0 for b in bundles)
+
+    # -- restart the follower from its durable state; lag converges to 0 --
+    f0b_proc, f0b_url, _ = _fleet_spawn(
+        common + ["--replica", "--replica-poll-ms", "20",
+                  "--replica-state", os.path.join(root, "f0"),
+                  "--run-log", runlog("f0b")])
+    # the follower restarts on a NEW ephemeral port, so the catch-up
+    # check reads the restarted replica's own metric surface (lag_seq
+    # back to 0 past the records appended while it was down)
+    caught_up = _fleetobs_wait(
+        lambda: _fleet_http(f0b_url, "/metrics.json")[1]
+        .get("fleet", {}).get("lag_seq") == 0
+        and _fleet_http(f0b_url, "/metrics.json")[1]
+        .get("fleet", {}).get("applied_seq", 0)
+        >= (lag_while_down.get("applied_seq") or 0) + 1)
+    f0b_snap = _fleet_http(f0b_url, "/metrics.json")[1].get("fleet", {})
+
+    # -- graceful drain everything, then merge --------------------------------
+    for proc in (front_proc, pub_proc, f0b_proc):
+        proc.send_signal(_signal.SIGTERM)
+    rcs = []
+    for proc in (front_proc, pub_proc, f0b_proc):
+        try:
+            proc.communicate(timeout=60)
+            rcs.append(proc.returncode)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rcs.append(None)
+    report = merge_run_logs(
+        [runlog(n) for n in ("front", "pub", "f0", "f0b")],
+        out_path=os.path.join(root, "fleet-trace.json"))
+    reqs = report["requests"]
+    score_trees = [reqs.get(rid) for rid in score_ids]
+    fb_tree = reqs.get(fb_rid)
+    score_trees_ok = bool(score_trees) and all(
+        t is not None and t["connected"] and len(t["processes"]) >= 2
+        for t in score_trees)
+    fb_names = set(fb_tree["span_names"]) if fb_tree else set()
+    feedback_tree_ok = bool(
+        fb_tree and fb_tree["connected"]
+        and len(fb_tree["processes"]) >= 3
+        and {"front_request", "serve_request", "online_update",
+             "replica_apply"} <= fb_names)
+    containment = report["containment"]
+    federated_ok = bool(
+        converged and lag_at_converged.get("lag_records") == 0
+        and lagged and (lag_while_down.get("lag_records") or 0) > 0
+        and caught_up and f0b_snap.get("lag_seq") == 0
+        and f'instance="{f0_url}"' in fed_text_converged
+        and f'instance="{pub_url}"' in fed_text_converged
+        and "photon_fleet_replica_lag_records" in fed_text_converged
+        and "photon_front_requests_total" in fed_text_converged)
+    flight_ok = bool(flight_correlated and len(bundle_procs) >= 2
+                     and "front" in bundle_procs and windows_cover)
+    return {
+        "name": "fleetobs_fleet",
+        "scoring_requests": len(score_ids),
+        "merge_problems": report["problems"][:5],
+        "merge_valid": not report["problems"],
+        "processes_merged": len(report["processes"]),
+        "clock_offsets": report["clock_offsets"],
+        "score_trees_ok": score_trees_ok,
+        "score_tree_sample": score_trees[0] if score_trees else None,
+        "feedback_tree": fb_tree,
+        "feedback_tree_ok": feedback_tree_ok,
+        "containment": {k: v for k, v in containment.items()
+                        if k != "violations"},
+        "containment_violations": len(containment["violations"]),
+        "containment_ok": containment["ok"],
+        "killed_returncode": killed_rc,
+        "lag_at_converged": lag_at_converged,
+        "lag_while_down": lag_while_down,
+        "lag_after_catchup": f0b_snap,
+        "federated_ok": federated_ok,
+        "flight_bundles": len(bundles),
+        "flight_bundle_procs": bundle_procs,
+        "flight_ok": flight_ok,
+        "drain_returncodes": rcs,
+        "fleet_ok": bool(not report["problems"] and score_trees_ok
+                         and feedback_tree_ok and containment["ok"]
+                         and federated_ok and flight_ok),
+    }
+
+
+def _fleetobs_health_flight_entry(smoke: bool, tmp: str) -> dict:
+    """Gate: a health-gate trip dumps a flight bundle whose ring holds
+    the triggering window — the health_gate_tripped event and the
+    evaluation spans that led to it are IN the bundle, on disk, before
+    any operator attaches."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry import flight as F
+
+    dump_dir = os.path.join(tmp, "health_flight")
+    rng = np.random.default_rng(139)
+    trips = 0
+    with telemetry.enabled(watch_compiles=False):
+        with F.enabled(dump_dir, proc="serve"):
+            svc, entities = _health_service(rng, smoke=True, health=True)
+            cfg = svc.health.config
+            try:
+                for _ in range(2):  # calibrated warmup windows
+                    f, i, y = _calibrated_batch(svc, rng, entities,
+                                                cfg.window_labels)
+                    svc.feedback(f, i, y)
+                    svc.updater.flush()
+                for _ in range(6):  # flipped labels until the gate trips
+                    f, i, y = _calibrated_batch(svc, rng, entities,
+                                                cfg.window_labels,
+                                                flip=True)
+                    svc.feedback(f, i, y)
+                    svc.updater.flush()
+                    trips = svc.metrics_snapshot()["health"]["gate_trips"]
+                    if trips:
+                        break
+            finally:
+                svc.close()
+    bundles = []
+    if os.path.isdir(dump_dir):
+        for name in sorted(os.listdir(dump_dir)):
+            if name.endswith(".json"):
+                with open(os.path.join(dump_dir, name)) as f:
+                    bundles.append(json.load(f))
+    health_bundles = [b for b in bundles
+                      if b["reason"] == "health.gate_trip"]
+    has_trip_event = any(
+        r.get("name") == "health_gate_tripped"
+        for b in health_bundles for r in b["records"])
+    has_eval_span = any(
+        r.get("kind") == "span" and r.get("name") == "health_evaluate"
+        for b in health_bundles for r in b["records"])
+    return {
+        "name": "fleetobs_health_flight",
+        "gate_trips": trips,
+        "bundles": len(bundles),
+        "health_bundles": len(health_bundles),
+        "bundle_records": (len(health_bundles[0]["records"])
+                           if health_bundles else 0),
+        "trip_event_in_bundle": has_trip_event,
+        "evaluate_span_in_bundle": has_eval_span,
+        "health_flight_ok": bool(trips >= 1 and health_bundles
+                                 and has_trip_event and has_eval_span),
+    }
+
+
+def _fleetobs_overhead_entry(smoke: bool, tmp: str) -> dict:
+    """Gate: armed fleet observability (tracer + flight ring + per-
+    request server_span context) costs <= 1.1x the disarmed scoring p99,
+    with ZERO fresh XLA traces armed and disarmed.  Alternating
+    disarmed/armed rounds, best p99 per arm (single-core noise
+    hygiene)."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry import distributed
+    from photon_ml_tpu.telemetry import flight as F
+
+    rng = np.random.default_rng(149)
+    svc, entities = _health_service(rng, smoke=smoke, health=False,
+                                    updates=False, E=200)
+    n_requests = 200 if smoke else 1000
+    rows = 4
+    requests = []
+    for _ in range(n_requests):
+        requests.append((
+            {"global": rng.normal(size=(rows, 16)),
+             "per_user": rng.normal(size=(rows, 8))},
+            {"userId": np.asarray(
+                [entities[rng.integers(0, len(entities))]
+                 for _ in range(rows)], dtype=object)}))
+
+    def one_round(armed):
+        lat = []
+        for k, (feats, ids) in enumerate(requests):
+            if armed:
+                t0 = time.perf_counter()
+                with distributed.server_span("serve_request",
+                                             {"X-Photon-Trace":
+                                              f"{k:016x}"},
+                                             path="/score"):
+                    svc.score(feats, ids)
+                lat.append(time.perf_counter() - t0)
+            else:
+                t0 = time.perf_counter()
+                svc.score(feats, ids)
+                lat.append(time.perf_counter() - t0)
+        return float(np.percentile(lat, 99))
+
+    try:
+        for feats, ids in requests[:32]:
+            svc.score(feats, ids)           # warm every bucket
+        dis_p99, arm_p99 = [], []
+        fresh_disarmed = fresh_armed = 0
+        rounds = 2 if smoke else 3
+        for _ in range(rounds):
+            with _trace_counting() as tc:
+                dis_p99.append(one_round(False))
+            fresh_disarmed += tc.count
+            with telemetry.enabled(watch_compiles=False):
+                with F.enabled(None, proc="serve"):
+                    with _trace_counting() as tc:
+                        arm_p99.append(one_round(True))
+            fresh_armed += tc.count
+    finally:
+        svc.close()
+    best_dis, best_arm = min(dis_p99), min(arm_p99)
+    ratio = best_arm / best_dis if best_dis > 0 else float("inf")
+    gated = not smoke
+    out = {
+        "name": "fleetobs_overhead",
+        "requests_per_round": n_requests, "rounds": rounds,
+        "disarmed_p99_ms": [round(1e3 * v, 3) for v in dis_p99],
+        "armed_p99_ms": [round(1e3 * v, 3) for v in arm_p99],
+        "p99_ratio_armed_vs_disarmed": round(ratio, 3),
+        "ratio_gate": 1.1,
+        "ratio_gated": gated,
+        "fresh_traces_disarmed": fresh_disarmed,
+        "fresh_traces_armed": fresh_armed,
+        "zero_traces_ok": fresh_disarmed == 0 and fresh_armed == 0,
+    }
+    if not gated:
+        out["ratio_gate_waived"] = (
+            "smoke mode on shared-core CI: the p99 ratio is measured "
+            "and reported; the full bench run gates it at 1.1x")
+    out["overhead_ok"] = bool(out["zero_traces_ok"]
+                              and (ratio <= 1.1 or not gated))
+    return out
+
+
+def fleetobs_bench(out_path="BENCH_fleetobs.json", smoke=False,
+                   max_wall=None):
+    """Fleet-observability gate (--fleetobs): (a) a front-routed scoring
+    request and a feedback -> delta -> replica-apply flow each render as
+    ONE connected span tree in the merged Perfetto export, children
+    inside parents after clock alignment; (b) the front's federated
+    /metrics exposes per-replica instance-labelled series and per-replica
+    lag that goes 0 -> >0 (follower SIGKILLed, publisher advancing) ->
+    0 (restart + catch-up); (c) flight-recorder bundles from the injected
+    replica crash (fleet-correlated trigger id) and from a health-gate
+    trip contain the triggering window; (d) armed observability <= 1.1x
+    disarmed scoring p99 (full runs; reported in smoke) with zero fresh
+    XLA traces armed and disarmed.  `value` is the armed/disarmed p99
+    ratio."""
+    import tempfile
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    t0 = time.perf_counter()
+    entries = []
+    truncated = []
+    with tempfile.TemporaryDirectory() as tmp:
+        legs = [
+            ("fleetobs_fleet", _fleetobs_fleet_entry),
+            ("fleetobs_health_flight", _fleetobs_health_flight_entry),
+            ("fleetobs_overhead", _fleetobs_overhead_entry),
+        ]
+        for name, fn in legs:
+            if max_wall is not None and \
+                    time.perf_counter() - t0 > max_wall:
+                truncated.append(name)
+                continue
+            entries.append(fn(smoke, tmp))
+    by_name = {e["name"]: e for e in entries}
+    fleet = by_name.get("fleetobs_fleet", {})
+    health = by_name.get("fleetobs_health_flight", {})
+    overhead = by_name.get("fleetobs_overhead", {})
+    gates = {
+        "merge_valid": fleet.get("merge_valid"),
+        "score_trees_ok": fleet.get("score_trees_ok"),
+        "feedback_tree_ok": fleet.get("feedback_tree_ok"),
+        "containment_ok": fleet.get("containment_ok"),
+        "federated_ok": fleet.get("federated_ok"),
+        "flight_ok": fleet.get("flight_ok"),
+        "health_flight_ok": health.get("health_flight_ok"),
+        "zero_traces_ok": overhead.get("zero_traces_ok"),
+        "overhead_ok": overhead.get("overhead_ok"),
+    }
+    result = {
+        "metric": "fleetobs_armed_vs_disarmed_scoring_p99_ratio",
+        "value": overhead.get("p99_ratio_armed_vs_disarmed"),
+        "unit": "x",
+        "detail": {
+            "smoke": smoke,
+            "entries": entries,
+            **gates,
+            "all_ok": all(bool(v) for v in gates.values()),
+            "truncated": truncated or False,
+            "suite_wall_s": round(time.perf_counter() - t0, 1),
+        },
+    }
+    _embed_telemetry(result)
+    tmp_path = out_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp_path, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 
 def warm_ref_cache():
     """Compute every GLM config's float64 CPU reference (optimum + solve
@@ -4420,6 +4879,14 @@ def _dispatch():
                  and (i == 0 or rest[i - 1] != "--max-wall")]
         fleet_bench(*(paths[:1] or ["BENCH_fleet.json"]), smoke=smoke,
                     max_wall=_parse_max_wall(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fleetobs":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        fleetobs_bench(*(paths[:1] or ["BENCH_fleetobs.json"]),
+                       smoke=smoke,
+                       max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--health":
         smoke = "--smoke" in sys.argv[2:]
         rest = sys.argv[2:]

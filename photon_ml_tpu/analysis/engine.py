@@ -577,6 +577,45 @@ def load_sites_registry(paths: Sequence[str]) -> Tuple[Dict[str,
     return {}, None
 
 
+def load_str_dict_registry(paths: Sequence[str], suffix: str,
+                           var_name: str, shipped_rel: str
+                           ) -> Tuple[Dict[str, str], Optional[str]]:
+    """Statically parse a module-level `VAR = {"str": "str", ...}` from
+    the first linted file whose path ends with `suffix`, falling back to
+    the shipped module at `shipped_rel` (package-relative).  How PH008
+    reads `telemetry.flight.TRIGGERS` and `telemetry.events.EVENTS`
+    without importing anything."""
+    candidates = [p for p in paths if p.endswith(suffix)]
+    shipped = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), *shipped_rel.split("/"))
+    if os.path.exists(shipped):
+        candidates.append(shipped)
+    for cand in candidates:
+        try:
+            with open(cand, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in tree.body:
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target] if isinstance(node, ast.AnnAssign)
+                       else [])
+            if not any(isinstance(t, ast.Name) and t.id == var_name
+                       for t in targets):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Dict):
+                continue
+            registry: Dict[str, str] = {}
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    registry[k.value] = (v.value if isinstance(v,
+                                                               ast.Constant)
+                                         else "")
+            return registry, cand
+    return {}, None
+
+
 # -- baseline -----------------------------------------------------------------
 
 class Baseline:
@@ -688,6 +727,12 @@ def lint_paths(paths: Sequence[str],
     from photon_ml_tpu.analysis.rules import all_rules
     files = iter_py_files(paths)
     registry, registry_path = load_sites_registry(files)
+    triggers, triggers_path = load_str_dict_registry(
+        files, os.path.join("telemetry", "flight.py"), "TRIGGERS",
+        "telemetry/flight.py")
+    events, events_path = load_str_dict_registry(
+        files, os.path.join("telemetry", "events.py"), "EVENTS",
+        "telemetry/events.py")
     matches = select_matcher(select)
     rules = [r for r in all_rules() if matches(r.rule_id)]
     module_rules = [r for r in rules
@@ -710,6 +755,10 @@ def lint_paths(paths: Sequence[str],
             continue
         ctx.sites_registry = registry
         ctx.sites_registry_path = registry_path
+        ctx.triggers_registry = triggers
+        ctx.triggers_registry_path = triggers_path
+        ctx.events_registry = events
+        ctx.events_registry_path = events_path
         contexts.append(ctx)
         for rule in module_rules:
             for f in rule.check(ctx):

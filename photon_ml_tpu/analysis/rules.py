@@ -585,8 +585,101 @@ class RawTimerRule(Rule):
         return findings
 
 
+# -- PH008: telemetry event-registry drift ------------------------------------
+
+class EventRegistryRule(Rule):
+    rule_id = "PH008"
+    name = "event-registry"
+    summary = ("every utils.faults.SITES name and telemetry.flight."
+               "TRIGGERS name needs a telemetry event constant in "
+               "telemetry/events.py (and vice versa — stale entries "
+               "fail too); flight.trigger() reasons must be literal "
+               "registered names")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        events = getattr(ctx, "events_registry", {}) or {}
+        triggers = getattr(ctx, "triggers_registry", {}) or {}
+        sites = getattr(ctx, "sites_registry", {}) or {}
+        # registry diffs are reported ON the registry modules, so the
+        # finding lands where the fix goes
+        if ctx.path == getattr(ctx, "sites_registry_path", None):
+            findings.extend(self._registry_diff(
+                ctx, "SITES", set(sites) - set(events)))
+        if ctx.path == getattr(ctx, "triggers_registry_path", None):
+            findings.extend(self._registry_diff(
+                ctx, "TRIGGERS", set(triggers) - set(events)))
+        if ctx.path == getattr(ctx, "events_registry_path", None):
+            stale = set(events) - set(sites) - set(triggers)
+            if stale:
+                node = self._dict_node(ctx, "EVENTS")
+                if node is not None:
+                    findings.append(ctx.finding(
+                        self.rule_id, node,
+                        f"stale telemetry event constant(s) "
+                        f"{sorted(stale)}: no fault site or flight "
+                        "trigger of that name exists — remove them (or "
+                        "register the site/trigger)"))
+        findings.extend(self._check_trigger_calls(ctx, triggers))
+        return findings
+
+    @staticmethod
+    def _dict_node(ctx, var_name: str):
+        for node in ctx.tree.body:
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target]
+                       if isinstance(node, ast.AnnAssign) else [])
+            if any(isinstance(t, ast.Name) and t.id == var_name
+                   for t in targets):
+                return node
+        return None
+
+    def _registry_diff(self, ctx, var_name: str, missing) -> List[Finding]:
+        if not missing:
+            return []
+        node = self._dict_node(ctx, var_name)
+        if node is None:
+            return []
+        return [ctx.finding(
+            self.rule_id, node,
+            f"{var_name} name(s) {sorted(missing)} have no telemetry "
+            "event constant — operators grep traces and flight bundles "
+            "by event name, so declare each in telemetry/events.py "
+            "EVENTS before the registry entry lands")]
+
+    def _check_trigger_calls(self, ctx, triggers) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            origin = ctx.resolve(node.func)
+            if origin is None or not (origin.endswith(".flight.trigger")
+                                      or origin == "flight.trigger"):
+                continue
+            if not node.args:
+                continue
+            reason = node.args[0]
+            if not (isinstance(reason, ast.Constant)
+                    and isinstance(reason.value, str)):
+                findings.append(ctx.finding(
+                    self.rule_id, reason,
+                    "dynamic flight-trigger reason — triggers must be "
+                    "string literals registered in telemetry.flight."
+                    "TRIGGERS so the dump taxonomy, docs, and greps "
+                    "agree (suppress forwarding sites that re-fire an "
+                    "already-validated reason)"))
+                continue
+            if triggers and reason.value not in triggers:
+                findings.append(ctx.finding(
+                    self.rule_id, reason,
+                    f"unregistered flight trigger {reason.value!r} — "
+                    "declare it in telemetry.flight.TRIGGERS (known: "
+                    f"{', '.join(sorted(triggers))})"))
+        return findings
+
+
 def all_rules() -> List[Rule]:
     from photon_ml_tpu.analysis.concurrency import concurrency_rules
     return [HostSyncRule(), RetraceHazardRule(), DonationSafetyRule(),
             FaultSiteRule(), DurableWriteRule(), NondeterminismRule(),
-            RawTimerRule()] + concurrency_rules()
+            RawTimerRule(), EventRegistryRule()] + concurrency_rules()

@@ -73,6 +73,20 @@ serving"):
       POST /fleet/drain {"replica": URL} drains one replica out of
       rotation.
 
+Fleet observability (COMPONENTS.md "Fleet observability"): --trace-out /
+--run-log arm the span tracer in EVERY mode (front/replica/publish
+included) with export on exit — including the SIGTERM drain path — at
+cli.train parity; per-process run logs merge into one fleet timeline via
+`python -m photon_ml_tpu.cli.trace merge`.  Requests propagate
+X-Photon-Trace / X-Photon-Parent headers end to end (front routing →
+replica scoring, /feedback → update cycle → replication record → replica
+apply).  The flight recorder is always armed (bounded in-memory ring);
+--flight-dir makes its dump-on-anomaly bundles durable, and POST
+/flight/dump triggers a correlated dump (the front broadcasts it when a
+replica leaves rotation).  A front's GET /metrics is the FEDERATED
+exposition (own registry + every replica's with instance labels +
+per-replica lag); GET /metrics/front is the front-only page.
+
 Burst mode (--burst DATA.npz) — drive a synthetic client burst from a
 GameDataset through the full micro-batching pipeline in-process, print the
 metrics snapshot as the last stdout line, and exit; --output writes the
@@ -176,6 +190,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-inflight", type=int, default=256,
                    help="front: concurrently routed requests before "
                         "shedding (429)")
+    # -- fleet observability (telemetry/distributed + telemetry/flight) -----
+    p.add_argument("--trace-out", default=None, metavar="TRACE.json",
+                   help="arm the telemetry span tracer and write a Chrome-"
+                        "trace timeline at exit (cli.train parity; works "
+                        "in every mode including --front/--replica/"
+                        "--publish, and on the SIGTERM drain path)")
+    p.add_argument("--run-log", default=None, metavar="RUN.jsonl",
+                   help="stream span/event records as JSONL while "
+                        "serving; arms the tracer like --trace-out.  The "
+                        "per-process run logs are what `python -m "
+                        "photon_ml_tpu.cli.trace merge` stitches into one "
+                        "fleet timeline")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="durable flight-recorder bundle directory: the "
+                        "always-on ring of recent spans/events/log lines "
+                        "dumps here on health-gate trips, replica "
+                        "failures, rollbacks, SIGTERM drain and crashes "
+                        "(without it the ring stays in memory only)")
+    p.add_argument("--flight-ring", type=int, default=4096,
+                   help="flight-recorder ring capacity (records)")
     p.add_argument("--event-listener", action="append", default=[],
                    help="dotted EventListener class path (repeatable); "
                         "receives ScoringBatchEvent/ModelSwapEvent")
@@ -232,6 +266,34 @@ def _build_service(args):
 
 def _dump_metrics(service, stream=sys.stderr):
     print(json.dumps(service.metrics_snapshot()), file=stream, flush=True)
+
+
+def _arm_observability(args, proc: str) -> None:
+    """cli.train wiring parity for the serve CLI, every mode: --trace-out
+    / --run-log arm the span tracer (run logs are what `cli.trace merge`
+    stitches); the flight recorder is ALWAYS armed — the ring stays in
+    memory until --flight-dir makes its dumps durable."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry import flight
+    if args.trace_out or args.run_log or args.flight_dir:
+        telemetry.install(run_log=args.run_log, proc=proc)
+    flight.install(dump_dir=args.flight_dir, proc=proc,
+                   ring_records=args.flight_ring)
+
+
+def _export_observability(args) -> None:
+    """Finish the tracer and export the Chrome trace — reached on clean
+    exit, SIGTERM drain, AND crash paths (the finally in main)."""
+    from photon_ml_tpu import telemetry
+    telemetry.shutdown()
+    if args.trace_out and telemetry.last_tracer() is not None:
+        try:
+            info = telemetry.write_chrome_trace(args.trace_out)
+            print(f"trace written to {args.trace_out} "
+                  f"({info['events']} events) — open at "
+                  "https://ui.perfetto.dev", file=sys.stderr)
+        except Exception as e:
+            print(f"trace export failed: {e}", file=sys.stderr)
 
 
 def _install_metrics_hooks(service, interval_s: float):
@@ -308,6 +370,7 @@ def _make_http_server(service, host: str, port: int, replica=None,
     import numpy as np
 
     from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
+    from photon_ml_tpu.telemetry import distributed, flight
 
     follower = replica is not None and publisher is None
 
@@ -352,6 +415,10 @@ def _make_http_server(service, host: str, port: int, replica=None,
                 self._reply(200, service.metrics_snapshot())
             elif self.path == "/healthz":
                 payload = service.healthz()
+                # every probe is also a clock probe: the front estimates
+                # this process's wall-clock offset from (pid, wall_s),
+                # which is what aligns the merged fleet timeline
+                payload["telemetry"] = distributed.clock_info()
                 if publisher is not None:
                     fleet = publisher.status()
                     # the publisher IS the source of truth: its applied
@@ -394,18 +461,29 @@ def _make_http_server(service, host: str, port: int, replica=None,
                 return self._reply(400, {"error": f"bad JSON: {e}"})
             try:
                 if self.path in ("/score", "/predict"):
-                    feats = {s: np.asarray(v, np.float64)
-                             for s, v in (req.get("features") or {}).items()}
-                    ids = {t: np.asarray(v, dtype=object)
-                           for t, v in (req.get("ids") or {}).items()}
-                    timeout = req.get("timeout_ms")
-                    timeout = None if timeout is None else timeout / 1e3
-                    if self.path == "/score":
-                        out = service.score(feats, ids, timeout=timeout)
-                        key = "scores"
-                    else:
-                        out = service.predict(feats, ids, timeout=timeout)
-                        key = "predictions"
+                    # the server half of the propagated hop: adopts the
+                    # front's X-Photon-Trace/-Parent headers (minting an
+                    # id for direct traffic), so this request's spans
+                    # join the fleet-wide tree at merge time
+                    with distributed.server_span("serve_request",
+                                                 self.headers,
+                                                 path=self.path):
+                        feats = {s: np.asarray(v, np.float64)
+                                 for s, v in (req.get("features")
+                                              or {}).items()}
+                        ids = {t: np.asarray(v, dtype=object)
+                               for t, v in (req.get("ids") or {}).items()}
+                        timeout = req.get("timeout_ms")
+                        timeout = (None if timeout is None
+                                   else timeout / 1e3)
+                        if self.path == "/score":
+                            out = service.score(feats, ids,
+                                                timeout=timeout)
+                            key = "scores"
+                        else:
+                            out = service.predict(feats, ids,
+                                                  timeout=timeout)
+                            key = "predictions"
                     self._reply(200, {key: np.asarray(out).tolist(),
                                       "model_version": service.model_version})
                 elif self.path == "/feedback":
@@ -425,13 +503,31 @@ def _make_http_server(service, host: str, port: int, replica=None,
                            for t, v in (req.get("ids") or {}).items()}
                     if req.get("labels") is None:
                         return self._reply(400, {"error": "labels required"})
-                    out = service.feedback(
-                        feats, ids, np.asarray(req["labels"], np.float64),
-                        weights=req.get("weights"),
-                        offsets=req.get("offsets"),
-                        event_ids=req.get("event_ids"))
+                    # the span scope is what stamps the request id onto
+                    # the buffered observations (updater.submit reads the
+                    # thread-local context), carrying it into the delta's
+                    # replication trace
+                    with distributed.server_span("serve_request",
+                                                 self.headers,
+                                                 path=self.path):
+                        out = service.feedback(
+                            feats, ids,
+                            np.asarray(req["labels"], np.float64),
+                            weights=req.get("weights"),
+                            offsets=req.get("offsets"),
+                            event_ids=req.get("event_ids"))
                     out["version_vector"] = service.version_vector()
                     self._reply(202, out)
+                elif self.path == "/flight/dump":
+                    # the front's fleet-wide postmortem fan-out (or an
+                    # operator asking for the window by hand)
+                    bundle = flight.trigger(
+                        req.get("reason") or "replica.unhealthy",  # photonlint: disable=PH008 -- forwards the broadcaster's already-validated reason (trigger() re-validates at runtime)
+                        trigger_id=req.get("trigger_id"),
+                        **{k: str(v)
+                           for k, v in (req.get("attrs") or {}).items()})
+                    self._reply(200, {"bundle": bundle,
+                                      "armed": flight.armed()})
                 elif self.path == "/swap":
                     if follower:
                         return self._reply(403, {
@@ -486,6 +582,7 @@ def _make_front_server(front, host: str, port: int):
 
     from photon_ml_tpu.fleet import NoReadyReplica
     from photon_ml_tpu.serving import Overloaded
+    from photon_ml_tpu.telemetry import distributed, flight
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -519,15 +616,25 @@ def _make_front_server(front, host: str, port: int):
 
         def do_GET(self):
             if self.path == "/metrics":
+                # the FEDERATED exposition: the front's own registry plus
+                # every reachable replica's, per-instance labels, plus
+                # the probe-derived per-replica replication lag
+                self._reply_text(
+                    200, front.federated_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/metrics/front":
+                # the front's own registry alone (the parity-contract
+                # surface; scrape this to exclude replica fan-out cost)
                 self._reply_text(
                     200, front.prometheus_metrics(),
                     "text/plain; version=0.0.4; charset=utf-8")
             elif self.path == "/metrics.json":
-                self._reply(200, front.metrics_snapshot())
+                self._reply(200, front.federated_snapshot())
             elif self.path == "/healthz":
                 status = front.status()
                 ok = status["ready_replicas"] > 0
                 status["status"] = "ok" if ok else "degraded"
+                status["telemetry"] = distributed.clock_info()
                 self._reply(200 if ok else 503, status)
             elif self.path == "/fleet/audit":
                 self._reply(200, front.audit())
@@ -543,13 +650,35 @@ def _make_front_server(front, host: str, port: int):
                 if self.path in ("/score", "/predict"):
                     timeout = req.get("timeout_ms")
                     timeout = None if timeout is None else timeout / 1e3
-                    status, payload = front.route(self.path, req,
-                                                  timeout=timeout)
+                    # adopt the client's trace context (if any) so
+                    # front.route()'s span carries the caller's id
+                    distributed.set_context(
+                        self.headers.get(distributed.TRACE_HEADER),
+                        self.headers.get(distributed.PARENT_HEADER))
+                    try:
+                        status, payload = front.route(self.path, req,
+                                                      timeout=timeout)
+                    finally:
+                        distributed.set_context(None, None)
                     self._reply(status, payload)
                 elif self.path in ("/feedback", "/swap", "/rollback"):
-                    status, payload, headers = front.route_publisher(
-                        "POST", self.path, req)
+                    distributed.set_context(
+                        self.headers.get(distributed.TRACE_HEADER),
+                        self.headers.get(distributed.PARENT_HEADER))
+                    try:
+                        status, payload, headers = front.route_publisher(
+                            "POST", self.path, req)
+                    finally:
+                        distributed.set_context(None, None)
                     self._reply(status, payload, headers)
+                elif self.path == "/flight/dump":
+                    bundle = flight.trigger(
+                        req.get("reason") or "replica.unhealthy",  # photonlint: disable=PH008 -- forwards the broadcaster's already-validated reason (trigger() re-validates at runtime)
+                        trigger_id=req.get("trigger_id"),
+                        **{k: str(v)
+                           for k, v in (req.get("attrs") or {}).items()})
+                    self._reply(200, {"bundle": bundle,
+                                      "armed": flight.armed()})
                 elif self.path == "/fleet/drain":
                     if not req.get("replica"):
                         return self._reply(
@@ -600,6 +729,7 @@ def _serve_with_graceful_drain(httpd, poll_interval: float = 0.1):
 
 def _run_front(args) -> int:
     from photon_ml_tpu.fleet import Front, FrontConfig
+    from photon_ml_tpu.telemetry import flight
     front = Front(
         args.replica_url, publisher_url=args.publisher_url,
         config=FrontConfig(
@@ -615,8 +745,9 @@ def _run_front(args) -> int:
         "replicas": args.replica_url,
         "publisher": args.publisher_url or args.replica_url[0],
         "endpoints": ["/score", "/predict", "/feedback", "/metrics",
-                      "/metrics.json", "/swap", "/rollback", "/healthz",
-                      "/fleet/audit", "/fleet/drain"],
+                      "/metrics/front", "/metrics.json", "/swap",
+                      "/rollback", "/healthz", "/fleet/audit",
+                      "/fleet/drain", "/flight/dump"],
     }), flush=True)
     try:
         drained, aborted = _serve_with_graceful_drain(httpd)
@@ -624,6 +755,7 @@ def _run_front(args) -> int:
         httpd.server_close()
         front.close()
     if drained:
+        flight.trigger("serve.drain", mode="front", aborted=aborted)
         print(json.dumps({"drained": True, "aborted": aborted,
                           "mode": "front"}), flush=True)
     return 0
@@ -634,17 +766,38 @@ def main(argv=None) -> int:
     if args.front:
         if not args.replica_url:
             raise SystemExit("--front requires at least one --replica-url")
-        return _run_front(args)
-    if not args.model_dir:
-        raise SystemExit("--model-dir is required (except in --front mode)")
-    if args.replica and not (args.replication_log and args.replica_state):
-        raise SystemExit("--replica requires --replication-log and "
-                         "--replica-state")
-    if args.enable_updates and args.replica and not args.publish:
-        raise SystemExit("a follower replica cannot run the online "
-                         "updater (--enable-updates needs --publish): "
-                         "model state enters the fleet through the "
-                         "replication log")
+    else:
+        if not args.model_dir:
+            raise SystemExit(
+                "--model-dir is required (except in --front mode)")
+        if args.replica and not (args.replication_log
+                                 and args.replica_state):
+            raise SystemExit("--replica requires --replication-log and "
+                             "--replica-state")
+        if args.enable_updates and args.replica and not args.publish:
+            raise SystemExit("a follower replica cannot run the online "
+                             "updater (--enable-updates needs --publish): "
+                             "model state enters the fleet through the "
+                             "replication log")
+    _arm_observability(args, proc_label(args))
+    from photon_ml_tpu.telemetry import flight
+    try:
+        if args.front:
+            return _run_front(args)
+        return _run_serve(args)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as e:
+        # the process is dying on an unhandled error: the ring holds the
+        # window that led here — get it on disk before the stack unwinds
+        flight.trigger("serve.crash", error=f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        _export_observability(args)
+
+
+def _run_serve(args) -> int:
+    from photon_ml_tpu.telemetry import flight
     from photon_ml_tpu.utils.jax_cache import enable_persistent_cache
     enable_persistent_cache()
     t0 = time.perf_counter()
@@ -695,13 +848,19 @@ def main(argv=None) -> int:
         "health_enabled": service.health is not None,
         "join": join_info,
         "endpoints": ["/score", "/predict", "/feedback", "/metrics",
-                      "/metrics.json", "/swap", "/rollback", "/healthz"]
+                      "/metrics.json", "/swap", "/rollback", "/healthz",
+                      "/flight/dump"]
         + (["/fleet/audit", "/fleet/drain"] if args.replica else []),
     }), flush=True)
     try:
         drained, aborted = _serve_with_graceful_drain(httpd)
     finally:
         httpd.server_close()
+    if drained:
+        # dump the flight ring BEFORE the flush/close teardown mutates
+        # state — the drain window is part of the postmortem trail
+        flight.trigger("serve.drain", mode=proc_label(args),
+                       aborted=aborted)
     flushed = None
     if drained and not aborted and service.updater is not None \
             and not service.updater.paused:
@@ -718,6 +877,12 @@ def main(argv=None) -> int:
             "feedback_flushed": flushed,
             "version_vector": service.version_vector()}), flush=True)
     return 0
+
+
+def proc_label(args) -> str:
+    return ("front" if args.front else
+            "publisher" if args.replica and args.publish else
+            "replica" if args.replica else "serve")
 
 
 if __name__ == "__main__":

@@ -444,7 +444,7 @@ def _run(args, log) -> int:
     from photon_ml_tpu import telemetry
     tracer = None
     if args.trace_out or args.run_log:
-        tracer = telemetry.install(run_log=args.run_log)
+        tracer = telemetry.install(run_log=args.run_log, proc="train")
         log.info("telemetry armed: trace_out=%s run_log=%s",
                  args.trace_out, args.run_log)
 

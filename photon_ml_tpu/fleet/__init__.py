@@ -11,7 +11,8 @@ routes scoring traffic across the ready replicas with failover, hedging,
 draining and explicit backpressure.  See COMPONENTS.md "Replicated
 serving" for the log format and the convergence argument.
 """
-from photon_ml_tpu.fleet.front import (Front, FrontConfig,  # noqa: F401
+from photon_ml_tpu.fleet.front import (FRONT_SNAPSHOT_PATHS,  # noqa: F401
+                                       Front, FrontConfig,
                                        NoReadyReplica, ReplicaHandle)
 from photon_ml_tpu.fleet.replica import (FleetPublisher,  # noqa: F401
                                          Replica, ReplicaConfig,

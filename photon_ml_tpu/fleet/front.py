@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import distributed, flight
 from photon_ml_tpu.telemetry.export import prometheus_text
 from photon_ml_tpu.telemetry.metrics import MetricsRegistry
 from photon_ml_tpu.serving.batcher import Overloaded, ServingError
@@ -50,8 +51,31 @@ from photon_ml_tpu.utils import locktrace
 
 import dataclasses
 import logging
+import re
+import time
 
 logger = logging.getLogger("photon_ml_tpu")
+
+
+#: the front's metric-surface parity CONTRACT (the ServingMetrics
+#: SNAPSHOT_PATHS discipline): every instrument the constructor registers
+#: must appear here, every path must resolve in `front_snapshot()`, and
+#: tests/test_fleetobs.py diffs all three sets against the Prometheus
+#: exposition — a front metric cannot land on one surface only.
+FRONT_SNAPSHOT_PATHS = {
+    "fleet.front_requests": ("requests",),
+    "fleet.front_failovers": ("failovers",),
+    "fleet.front_hedges": ("hedges",),
+    "fleet.front_hedge_wins": ("hedge_wins",),
+    "fleet.front_retries": ("retries",),
+    "fleet.front_shed": ("shed",),
+    "fleet.front_errors": ("errors",),
+    "fleet.front_probe_failures": ("probe_failures",),
+    "fleet.front_scrape_failures": ("scrape_failures",),
+    "fleet.front_ready_replicas": ("ready_replicas",),
+    "fleet.front_max_lag_seq": ("max_lag_seq",),
+    "front.requests": ("requests_by_replica",),
+}
 
 
 class NoReadyReplica(ServingError):
@@ -123,12 +147,18 @@ class Front:
         self._m_requests = r.counter("fleet.front_requests")
         self._m_failovers = r.counter("fleet.front_failovers")
         self._m_hedges = r.counter("fleet.front_hedges")
+        self._m_hedge_wins = r.counter("fleet.front_hedge_wins")
         self._m_retries = r.counter("fleet.front_retries")
         self._m_shed = r.counter("fleet.front_shed")
         self._m_errors = r.counter("fleet.front_errors")
         self._m_probe_failures = r.counter("fleet.front_probe_failures")
+        self._m_scrape_failures = r.counter("fleet.front_scrape_failures")
         self._m_ready = r.gauge("fleet.front_ready_replicas")
         self._m_max_lag = r.gauge("fleet.front_max_lag_seq")
+        # per-(replica, outcome) routing visibility: which replica served,
+        # failed over, shed, or was abandoned as a hedge loser
+        self._m_by_replica = r.labeled_counter("front.requests",
+                                               ("replica", "outcome"))
         self._pool = ThreadPoolExecutor(
             max_workers=max(8, min(config.max_inflight, 64)),
             thread_name_prefix="photon-front")
@@ -147,14 +177,29 @@ class Front:
             handles = [h for h in self._handles if not h.detached]
         for h in handles:
             ok, payload = False, None
+            t_send = time.time()
             try:
                 status, body = self._send(h, "GET", "/healthz", None,
                                           cfg.probe_timeout_s)
+                t_recv = time.time()
                 payload = json.loads(body) if body else {}
                 ok = status == 200
                 err = None if ok else f"healthz {status}"
             except Exception as e:
                 err = f"{type(e).__name__}: {e}"
+            # every health probe doubles as an NTP-style clock probe: the
+            # replica's /healthz carries its wall clock, and the minimum-
+            # RTT offset estimate is what `cli.trace merge` aligns the
+            # per-process timelines with
+            remote_clock = (payload or {}).get("telemetry") or {}
+            if remote_clock.get("wall_s") is not None:
+                telemetry.event(
+                    "clock_probe", url=h.url,
+                    pid=int(remote_clock.get("pid", 0)),
+                    proc=str(remote_clock.get("proc", "proc")),
+                    offset_s=round(float(remote_clock["wall_s"])
+                                   - (t_send + t_recv) / 2.0, 6),
+                    rtt_s=round(t_recv - t_send, 6))
             with self._lock:
                 was_ready = h.ready
                 if ok:
@@ -182,8 +227,38 @@ class Front:
                 logger.warning("front: replica %s -> %s%s", h.url,
                                "READY" if now_ready else "OUT",
                                f" ({err})" if err else "")
+                if not now_ready:
+                    # a replica just left rotation (crash, health gate,
+                    # drain elsewhere): capture the window fleet-wide —
+                    # dump the front's own ring and fan the SAME trigger
+                    # id out so every live process's bundle correlates
+                    self._flight_fleet_dump("replica.unhealthy",
+                                            url=h.url, error=str(err))
         self._refresh_gauges()
         return results
+
+    def _flight_fleet_dump(self, reason: str, **attrs) -> None:
+        """Dump the front's flight ring and broadcast the trigger to
+        every other attached, reachable replica (fire-and-forget on the
+        pool: a postmortem capture must not block probing/routing)."""
+        if not flight.armed():
+            return
+        trigger_id = flight.new_trigger_id(reason)
+        flight.trigger(reason, trigger_id=trigger_id, **attrs)  # photonlint: disable=PH008 -- fans out a caller-validated registered reason
+        body = json.dumps({"reason": reason, "trigger_id": trigger_id,
+                           "attrs": {k: str(v) for k, v in attrs.items()}
+                           }).encode()
+        with self._lock:
+            handles = [h for h in self._handles if not h.detached]
+        for h in handles:
+            self._pool.submit(self._flight_dump_one, h, body)
+
+    def _flight_dump_one(self, h: "ReplicaHandle", body: bytes) -> None:
+        try:
+            self._send(h, "POST", "/flight/dump", body,
+                       self.config.probe_timeout_s)
+        except Exception:
+            pass  # the crashed replica itself is expected to be gone
 
     def _refresh_gauges(self) -> None:
         with self._lock:
@@ -216,13 +291,16 @@ class Front:
 
     @staticmethod
     def _send(h: ReplicaHandle, method: str, path: str,
-              body: Optional[bytes], timeout: float
+              body: Optional[bytes], timeout: float,
+              extra_headers: Optional[Dict[str, str]] = None
               ) -> Tuple[int, bytes]:
         conn = HTTPConnection(h.host, h.port, timeout=timeout)
         try:
             headers = {"Content-Type": "application/json"}
             if body is not None:
                 headers["Content-Length"] = str(len(body))
+            if extra_headers:
+                headers.update(extra_headers)
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
             return resp.status, resp.read()
@@ -275,21 +353,37 @@ class Front:
         self._m_requests.inc()
         body = json.dumps(payload).encode()
         timeout = timeout if timeout is not None else cfg.request_timeout_s
+        # ONE logical request = ONE trace: adopt the caller's propagated
+        # request id (X-Photon-Trace via the HTTP front or an enclosing
+        # server_span) or mint one; every attempt — failover or hedge —
+        # carries the same id with this span as the remote parent, so the
+        # merged timeline shows the request crossing processes
+        request_id = (distributed.current_request_id()
+                      or distributed.new_request_id())
         try:
-            return self._route_attempts(path, body, timeout)
+            with distributed.server_span(
+                    "front_request", None, request_id=request_id,
+                    remote_parent=distributed.current_ref(),
+                    path=path) as scope:
+                trace_headers = distributed.outbound_headers(
+                    scope.request_id, distributed.current_ref())
+                return self._route_attempts(path, body, timeout,
+                                            trace_headers)
         finally:
             with self._lock:
                 self._inflight_total -= 1
 
-    def _route_attempts(self, path: str, body: bytes,
-                        timeout: float) -> Tuple[int, dict]:
+    def _route_attempts(self, path: str, body: bytes, timeout: float,
+                        trace_headers: Optional[Dict[str, str]] = None
+                        ) -> Tuple[int, dict]:
         cfg = self.config
         tried: set = set()
         pending: Dict[object, ReplicaHandle] = {}
+        is_hedge: Dict[object, bool] = {}
         sends = 0
         last_client_error: Optional[Tuple[int, dict]] = None
 
-        def launch() -> bool:
+        def launch(hedge: bool = False) -> bool:
             nonlocal sends
             h = self._pick(exclude=tried)
             if h is None:
@@ -297,9 +391,13 @@ class Front:
             tried.add(h.url)
             sends += 1
             fut = self._pool.submit(self._send, h, "POST", path, body,
-                                    timeout)
+                                    timeout, trace_headers)
             pending[fut] = h
+            is_hedge[fut] = hedge
             return True
+
+        def outcome(h: ReplicaHandle, kind: str) -> None:
+            self._m_by_replica.inc(replica=h.url, outcome=kind)
 
         if not launch():
             self._m_errors.inc()
@@ -318,7 +416,7 @@ class Front:
                     # the attempt is slow, not dead: hedge a duplicate at
                     # a different replica, first response wins
                     hedged = True
-                    if launch():
+                    if launch(hedge=True):
                         self._m_hedges.inc()
                         telemetry.event("front_hedged", path=path)
                     continue
@@ -330,10 +428,12 @@ class Front:
                     except Exception as e:
                         self._mark_failure(h, f"{type(e).__name__}: {e}")
                         self._m_failovers.inc()
+                        outcome(h, "error")
                         continue
                     if status >= 500:
                         self._mark_failure(h, f"http {status}")
                         self._m_failovers.inc()
+                        outcome(h, "5xx")
                         continue
                     try:
                         decoded = json.loads(raw) if raw else {}
@@ -344,7 +444,15 @@ class Front:
                         # else propagate the shed to the client
                         last_client_error = (status, decoded)
                         self._m_retries.inc()
+                        outcome(h, "429")
                         continue
+                    outcome(h, "ok")
+                    if is_hedge.get(fut):
+                        # the duplicate beat the original: the hedge
+                        # bought this request its latency back
+                        self._m_hedge_wins.inc()
+                        telemetry.event("front_hedge_won", path=path,
+                                        replica=h.url)
                     return status, decoded
                 if not pending and sends < cfg.max_attempts:
                     if launch():
@@ -360,6 +468,7 @@ class Front:
             for fut, h in pending.items():
                 # abandoned hedges: release accounting; the send itself
                 # finishes (or times out) on the pool thread
+                outcome(h, "abandoned")
                 fut.add_done_callback(
                     lambda _f, _h=h: self._release(_h))
 
@@ -384,14 +493,22 @@ class Front:
         body = None if payload is None else json.dumps(payload).encode()
         timeout = (timeout if timeout is not None
                    else self.config.request_timeout_s)
+        request_id = (distributed.current_request_id()
+                      or distributed.new_request_id())
         conn = HTTPConnection(h.host, h.port, timeout=timeout)
         try:
-            headers = {"Content-Type": "application/json"}
-            if body is not None:
-                headers["Content-Length"] = str(len(body))
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            raw = resp.read()
+            with distributed.server_span(
+                    "front_request", None, request_id=request_id,
+                    remote_parent=distributed.current_ref(),
+                    path=path) as scope:
+                headers = {"Content-Type": "application/json"}
+                if body is not None:
+                    headers["Content-Length"] = str(len(body))
+                headers.update(distributed.outbound_headers(
+                    scope.request_id, distributed.current_ref()))
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
             passthrough = {}
             retry_after = resp.getheader("Retry-After")
             if retry_after:
@@ -490,6 +607,141 @@ class Front:
     def metrics_snapshot(self) -> Dict[str, object]:
         self._refresh_gauges()
         return self.registry.snapshot()
+
+    # -- federated metrics ----------------------------------------------------
+
+    def front_snapshot(self) -> Dict[str, object]:
+        """The front's OWN instruments as the friendly JSON surface —
+        the shape FRONT_SNAPSHOT_PATHS (the metric-surface parity
+        contract) declares, path for path."""
+        self._refresh_gauges()
+        snap = self.registry.snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        return {
+            "requests": c["fleet.front_requests"],
+            "failovers": c["fleet.front_failovers"],
+            "hedges": c["fleet.front_hedges"],
+            "hedge_wins": c["fleet.front_hedge_wins"],
+            "retries": c["fleet.front_retries"],
+            "shed": c["fleet.front_shed"],
+            "errors": c["fleet.front_errors"],
+            "probe_failures": c["fleet.front_probe_failures"],
+            "scrape_failures": c["fleet.front_scrape_failures"],
+            "ready_replicas": g["fleet.front_ready_replicas"],
+            "max_lag_seq": g["fleet.front_max_lag_seq"],
+            "requests_by_replica": snap["labeled"]["front.requests"],
+        }
+
+    def _fleet_lag(self) -> Dict[str, object]:
+        """Per-replica replication lag derived from the probe payloads:
+        the publisher's applied seq IS the log head, so every replica's
+        record lag is observable from the front alone."""
+        with self._lock:
+            head = max((h.applied_seq for h in self._handles
+                        if h.publisher and h.applied_seq is not None),
+                       default=None)
+            per = {h.url: {
+                "applied_seq": h.applied_seq,
+                "lag_records": (None if h.applied_seq is None
+                                or head is None
+                                else max(head - h.applied_seq, 0)),
+                "ready": int(h.ready and not h.detached),
+                "publisher": h.publisher,
+            } for h in self._handles if not h.detached}
+        return {"publisher_head_seq": head, "replicas": per}
+
+    def _scrape(self, h: ReplicaHandle, path: str):
+        """(status, body) from one replica's metrics surface, or None —
+        scrape failures are counted, never propagated (a dead replica
+        must not take the fleet's metrics page down)."""
+        try:
+            status, body = self._send(h, "GET", path, None,
+                                      self.config.probe_timeout_s)
+            if status != 200:
+                raise RuntimeError(f"http {status}")
+            return body
+        except Exception as e:
+            self._m_scrape_failures.inc()
+            logger.debug("front: metrics scrape of %s%s failed: %s",
+                         h.url, path, e)
+            return None
+
+    def federated_snapshot(self) -> Dict[str, object]:
+        """The fleet's JSON metrics surface: the front's own instruments
+        plus every attached replica's /metrics.json, keyed by instance,
+        plus the probe-derived per-replica replication lag."""
+        with self._lock:
+            handles = [h for h in self._handles if not h.detached]
+        replicas: Dict[str, object] = {}
+        for h in handles:
+            body = self._scrape(h, "/metrics.json")
+            if body is None:
+                replicas[h.url] = {"error": "unreachable"}
+                continue
+            try:
+                replicas[h.url] = json.loads(body)
+            except ValueError:
+                replicas[h.url] = {"error": "undecodable"}
+        return {"front": self.front_snapshot(), "replicas": replicas,
+                "fleet": self._fleet_lag()}
+
+    _SERIES_RE = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s(.*)$")
+
+    def _relabel(self, text: str, instance: str, lines: List[str],
+                 seen_types: set) -> None:
+        """Stamp a scraped exposition page with an instance label so the
+        per-replica series coexist on one federated page."""
+        for line in text.splitlines():
+            if line.startswith("# TYPE"):
+                if line not in seen_types:
+                    seen_types.add(line)
+                    lines.append(line)
+                continue
+            if line.startswith("#") or not line.strip():
+                continue
+            m = self._SERIES_RE.match(line)
+            if not m:
+                continue
+            name, _brace, labels, value = m.groups()
+            inner = f'instance="{instance}"'
+            if labels:
+                inner += "," + labels
+            lines.append(f"{name}{{{inner}}} {value}")
+
+    def federated_prometheus(self) -> str:
+        """The fleet's Prometheus surface (the front's GET /metrics):
+        the front's own registry plus every healthy replica's and the
+        publisher's exposition, per-replica instance labels, plus the
+        probe-derived per-replica lag series."""
+        self._refresh_gauges()
+        lines: List[str] = []
+        seen_types: set = set()
+        self._relabel(prometheus_text(self.registry), "front", lines,
+                      seen_types)
+        with self._lock:
+            handles = [h for h in self._handles if not h.detached]
+        for h in handles:
+            body = self._scrape(h, "/metrics")
+            if body is None:
+                continue
+            self._relabel(body.decode("utf-8", "replace"), h.url, lines,
+                          seen_types)
+        lag = self._fleet_lag()
+        for series in ("photon_fleet_replica_applied_seq",
+                       "photon_fleet_replica_lag_records",
+                       "photon_fleet_replica_ready"):
+            lines.append(f"# TYPE {series} gauge")
+        for url, st in sorted(lag["replicas"].items()):
+            if st["applied_seq"] is not None:
+                lines.append(f'photon_fleet_replica_applied_seq'
+                             f'{{instance="{url}"}} {st["applied_seq"]}')
+            if st["lag_records"] is not None:
+                lines.append(f'photon_fleet_replica_lag_records'
+                             f'{{instance="{url}"}} {st["lag_records"]}')
+            lines.append(f'photon_fleet_replica_ready'
+                         f'{{instance="{url}"}} {st["ready"]}')
+        return "\n".join(lines) + "\n"
 
     def close(self) -> None:
         self._closed.set()

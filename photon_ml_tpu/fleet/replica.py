@@ -51,6 +51,7 @@ import time
 from typing import Dict, Optional
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import flight
 from photon_ml_tpu.telemetry.timings import clock
 
 from photon_ml_tpu.fleet.replog import (ReplicationLog, ReplicationLogError,
@@ -330,6 +331,14 @@ class Replica:
             self._fold_record(env)
             applied = int(env["log_seq"])
             count += 1
+            now = time.time()
+            # log-append -> replica-apply latency (the record envelope
+            # carries its append wall time) + end-to-end feedback ->
+            # fleet-visible latency for delta records whose trace names
+            # the oldest intake time
+            self.service.metrics.observe_replica_record(
+                apply_latency_s=max(now - float(env.get("t", now)), 0.0),
+                feedback_visible_s=self._feedback_visible_s(env, now))
             if count % max(self.config.ack_every, 1) == 0:
                 self._persist_applied(applied)
         with self._lock:
@@ -337,6 +346,14 @@ class Replica:
                 self._head_seen = max(self._head_seen,
                                       int(records[-1]["log_seq"]))
         return applied, count
+
+    @staticmethod
+    def _feedback_visible_s(env: dict, now: float):
+        trace = env["record"].get("trace") or {}
+        oldest = trace.get("enqueued_wall_s")
+        if env["record"].get("kind") != "delta" or not oldest:
+            return None
+        return max(now - float(oldest), 0.0)
 
     def poll_once(self) -> int:
         """One tail-apply cycle (the poll loop's body).  Returns the
@@ -358,6 +375,9 @@ class Replica:
                          "replica failed — /healthz degrades and the "
                          "front stops routing here", msg)
             telemetry.event("replica_failed", error=msg)
+            # the postmortem window is NOW: dump the flight ring before
+            # the operator (or the orchestrator) restarts the process
+            flight.trigger("replica.failed", error=msg)
             return 0
         if count:
             self._persist_applied(new_applied)
@@ -393,12 +413,16 @@ class Replica:
     def _apply_with_retry(self, env: dict) -> None:
         cfg = self.config
         attempt = 0
+        trace = env["record"].get("trace") or {}
         while True:
             attempt += 1
             try:
-                with telemetry.span("replica_apply",
-                                    seq=int(env["log_seq"]),
-                                    kind=env["record"]["kind"]):
+                with telemetry.span(
+                        "replica_apply", seq=int(env["log_seq"]),
+                        kind=env["record"]["kind"],
+                        request_ids=",".join(
+                            trace.get("request_ids") or ()),
+                        remote_parent=trace.get("parent")):
                     self._apply_record(env)
                 return
             except (KeyboardInterrupt, SystemExit):

@@ -425,15 +425,22 @@ def record_for_event(event: dict) -> dict:
                 "source_dir": event.get("source_dir")}
     if kind == "delta":
         delta = event["delta"]
-        return {"kind": "delta", "version": event["version"],
-                "base_version": delta.base_version,
-                "delta_seq": int(delta.seq),
-                "created_at": float(delta.created_at),
-                "coordinates": {
-                    lane: {"rows": encode_array(cd.rows),
-                           "values": encode_array(cd.values),
-                           "prior": encode_array(cd.prior)}
-                    for lane, cd in delta.coordinates.items()}}
+        rec = {"kind": "delta", "version": event["version"],
+               "base_version": delta.base_version,
+               "delta_seq": int(delta.seq),
+               "created_at": float(delta.created_at),
+               "coordinates": {
+                   lane: {"rows": encode_array(cd.rows),
+                          "values": encode_array(cd.values),
+                          "prior": encode_array(cd.prior)}
+                   for lane, cd in delta.coordinates.items()}}
+        if getattr(delta, "trace", None):
+            # cross-process trace metadata (request ids + publisher span
+            # ref + oldest intake wall time): replicas attach it to their
+            # apply spans so `cli.trace merge` stitches the feedback ->
+            # delta -> apply flow into one tree
+            rec["trace"] = dict(delta.trace)
+        return rec
     if kind == "delta_rollback":
         return {"kind": "delta_rollback", "version": event["version"],
                 "to_delta_seq": int(event["to_delta_seq"]),

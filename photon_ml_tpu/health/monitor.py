@@ -41,6 +41,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import flight
 from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve
 from photon_ml_tpu.health.calibration import StreamingCalibration
 from photon_ml_tpu.health.config import GATE_NAMES, HealthConfig
@@ -410,6 +411,13 @@ class HealthMonitor:
                            name, value, threshold)
             if self.metrics is not None:
                 self.metrics.observe_health_trip()
+        if outcome["tripped"]:
+            # the flight ring holds the windows that led to the trip —
+            # dump BEFORE acting (pause/rollback mutate the state the
+            # postmortem needs to see)
+            flight.trigger("health.gate_trip",
+                           gates=",".join(n for n, _v, _t
+                                          in outcome["tripped"]))
         for name in outcome["recovered"]:
             telemetry.event("health_gate_recovered", gate=name)
             logger.info("health gate %r recovered", name)

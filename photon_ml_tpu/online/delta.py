@@ -69,6 +69,13 @@ class ModelDelta:
     seq: int
     coordinates: Dict[str, CoordinateDelta]
     created_at: float = 0.0          # wall-clock time.time() at build
+    #: cross-process trace metadata (telemetry.distributed): the sampled
+    #: propagated request ids this delta aggregates, the publisher's
+    #: update-cycle span ref, and the oldest intake wall time — rides the
+    #: replication record so replica applies join the same trace tree.
+    #: Optional and JSON-plain; bit-identity of the model state never
+    #: depends on it.
+    trace: Dict[str, object] = None
 
     def __post_init__(self):
         if not self.coordinates:

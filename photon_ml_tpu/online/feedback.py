@@ -50,6 +50,11 @@ class Observation:
     offset: float
     enqueued_at: float                  # monotonic clock at intake
     event_id: Optional[str] = None
+    trace_id: Optional[str] = None      # propagated request id (X-Photon-
+                                        # Trace): rides into the delta's
+                                        # replication-record trace metadata
+    enqueued_wall_s: float = 0.0        # wall clock at intake (fleet-
+                                        # visible latency measures from it)
 
 
 @dataclasses.dataclass

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import distributed
 from photon_ml_tpu.telemetry.timings import clock
 
 from photon_ml_tpu.game.anchored import lane_all_finite, solve_anchored
@@ -170,6 +171,8 @@ class OnlineUpdater:
                              f"{len(event_ids)}")
         feats = {s: np.asarray(x) for s, x in features.items()}
         now = clock()
+        wall_now = time.time()
+        trace_id = distributed.current_request_id()
         entries: List[Tuple[str, object, int, Observation]] = []
         unseen = frozen = 0
         lane_meta = scorer.updatable_coordinates()
@@ -183,7 +186,8 @@ class OnlineUpdater:
                 ids={t: np.asarray(ids[t])[i] for t in ids},
                 label=float(labels[i]), weight=float(weights_a[i]),
                 offset=float(offsets_a[i]), enqueued_at=now,
-                event_id=None if event_ids is None else event_ids[i])
+                event_id=None if event_ids is None else event_ids[i],
+                trace_id=trace_id, enqueued_wall_s=wall_now)
             for lane, _shard, re_type in lane_meta:
                 entity_id = obs.ids.get(re_type)
                 row = scorer.entity_row(lane, entity_id)
@@ -285,10 +289,17 @@ class OnlineUpdater:
             drained = self.buffer.drain(lane, self.config.micro_batch)
             if not drained:
                 continue
+            # the propagated request ids this cycle aggregates: the span
+            # attr (and the delta's replication-trace metadata) is what
+            # lets `cli.trace merge` stitch a /feedback request through
+            # the asynchronous cycle into one tree
+            trace_ids, oldest_wall = self._trace_meta(drained)
             with telemetry.span("online_update", coordinate=lane,
-                                entities=len(drained)):
-                published = self._solve_and_publish(scorer, lane, shard,
-                                                    drained)
+                                entities=len(drained),
+                                request_ids=",".join(trace_ids)):
+                published = self._solve_and_publish(
+                    scorer, lane, shard, drained,
+                    trace_ids=trace_ids, oldest_wall=oldest_wall)
             if published:
                 totals["entities"] += published["entities"]
                 totals["rows"] += published["rows"]
@@ -388,6 +399,28 @@ class OnlineUpdater:
                 "last_cycle_age_s": (None if last is None
                                      else clock() - last)}
 
+    #: distinct request ids carried per update cycle / delta record (the
+    #: trace metadata is a sample, not an unbounded join table)
+    MAX_TRACE_IDS = 16
+
+    @classmethod
+    def _trace_meta(cls, drained: List[EntityFeedback]):
+        """-> (distinct propagated request ids, oldest intake wall time)
+        across the drained entities' observations."""
+        ids: List[str] = []
+        seen = set()
+        oldest = None
+        for ef in drained:
+            for obs in ef.observations:
+                w = obs.enqueued_wall_s
+                if w and (oldest is None or w < oldest):
+                    oldest = w
+                t = obs.trace_id
+                if t and t not in seen and len(ids) < cls.MAX_TRACE_IDS:
+                    seen.add(t)
+                    ids.append(t)
+        return ids, oldest
+
     def _blocks_for(self, scorer, shard: str,
                     drained: List[EntityFeedback]):
         """Drained entities -> the batched solver's padded layout:
@@ -481,7 +514,9 @@ class OnlineUpdater:
         return loss
 
     def _solve_and_publish(self, scorer, lane: str, shard: str,
-                           drained: List[EntityFeedback]
+                           drained: List[EntityFeedback],
+                           trace_ids: Optional[List[str]] = None,
+                           oldest_wall: Optional[float] = None
                            ) -> Optional[Dict[str, int]]:
         cfg = self.config
         t0 = clock()
@@ -549,7 +584,11 @@ class OnlineUpdater:
                 rows=np.asarray(keep_rows, np.int64),
                 values=np.stack(keep_values),
                 prior=np.stack(keep_prior))},
-            created_at=time.time())
+            created_at=time.time(),
+            trace={"request_ids": list(trace_ids or ()),
+                   "parent": distributed.span_ref(
+                       telemetry.current_span_id()),
+                   "enqueued_wall_s": oldest_wall})
         try:
             self._publish_with_retry(lane, delta, t0)
         except StaleDeltaError:

@@ -107,10 +107,13 @@ SNAPSHOT_PATHS = {
     "health.freezes_window": ("health", "freezes_window"),
     "fleet.applied_seq": ("fleet", "applied_seq"),
     "fleet.lag_seq": ("fleet", "lag_seq"),
+    "fleet.lag_seconds": ("fleet", "lag_seconds"),
     "fleet.ready": ("fleet", "ready"),
     "fleet.records_applied": ("fleet", "records_applied"),
     "fleet.apply_retries": ("fleet", "apply_retries"),
     "fleet.catchup_s": ("fleet", "catchup_s"),
+    "fleet.apply_latency_s": ("fleet", "apply_latency_ms"),
+    "fleet.feedback_visible_s": ("fleet", "feedback_visible_ms"),
 }
 
 
@@ -216,10 +219,19 @@ class ServingMetrics:
         # the FRONT's routing counters live on its own registry, not here
         self._fleet_applied_seq = r.gauge("fleet.applied_seq")
         self._fleet_lag_seq = r.gauge("fleet.lag_seq")
+        self._fleet_lag_seconds = r.gauge("fleet.lag_seconds")
         self._fleet_ready = r.gauge("fleet.ready")
         self._fleet_records = r.counter("fleet.records_applied")
         self._fleet_apply_retries = r.counter("fleet.apply_retries")
         self._fleet_catchup = r.gauge("fleet.catchup_s")
+        # log-append -> replica-apply latency per record, and the
+        # end-to-end feedback -> fleet-visible latency (the fleet-wide
+        # extension of online.feedback_to_publish_s: intake on the
+        # publisher -> the delta live in THIS replica's tables)
+        self._fleet_apply_latency = r.histogram("fleet.apply_latency_s",
+                                                reservoir=latency_window)
+        self._fleet_feedback_visible = r.histogram(
+            "fleet.feedback_visible_s", reservoir=latency_window)
 
     # counter-value conveniences (tests and embedding callers read these
     # like the old plain-int attributes)
@@ -324,6 +336,19 @@ class ServingMetrics:
         self._fleet_lag_seq.set(max(int(lag_seq), 0))
         if records:
             self._fleet_records.inc(records)
+        elif lag_seq <= 0:
+            # an empty poll at the log head: the replica is caught up
+            self._fleet_lag_seconds.set(0.0)
+
+    def observe_replica_record(self, *, apply_latency_s: float,
+                               feedback_visible_s=None) -> None:
+        """One replicated record landed: append->apply latency (and, for
+        delta records carrying intake trace metadata, the end-to-end
+        feedback->fleet-visible latency)."""
+        self._fleet_apply_latency.observe(apply_latency_s)
+        self._fleet_lag_seconds.set(round(float(apply_latency_s), 6))
+        if feedback_visible_s is not None:
+            self._fleet_feedback_visible.observe(feedback_visible_s)
 
     def observe_replica_ready(self, ready: bool,
                               catchup_s: float = None) -> None:
@@ -570,16 +595,31 @@ class ServingMetrics:
             "freezes_window": self._health_freezes.value,
         }
 
+    @staticmethod
+    def _latency_ms(h: Dict) -> Optional[Dict]:
+        if not h["count"]:
+            return None
+        out = {key: round(1e3 * h[src], 3)
+               for key, src in (("p50", "p50"), ("p99", "p99"),
+                                ("max", "max"))}
+        out["window"] = h["window"]
+        return out
+
     def _fleet_snapshot(self) -> Dict:
         """The replicated-serving tier's replica-side state (all zeros
         outside --replica mode — the instruments exist either way)."""
         return {
             "applied_seq": self._fleet_applied_seq.value,
             "lag_seq": self._fleet_lag_seq.value,
+            "lag_seconds": self._fleet_lag_seconds.value,
             "ready": self._fleet_ready.value,
             "records_applied": self._fleet_records.value,
             "apply_retries": self._fleet_apply_retries.value,
             "catchup_s": self._fleet_catchup.value,
+            "apply_latency_ms": self._latency_ms(
+                self._fleet_apply_latency.snapshot()),
+            "feedback_visible_ms": self._latency_ms(
+                self._fleet_feedback_visible.snapshot()),
         }
 
     def prometheus(self, model_version: Optional[str] = None) -> str:

@@ -33,6 +33,7 @@ from typing import Callable, Optional, Tuple
 import numpy as np
 
 from photon_ml_tpu.serving.scorer import CompiledScorer
+from photon_ml_tpu.telemetry import flight
 from photon_ml_tpu.utils import faults, locktrace
 from photon_ml_tpu.utils.events import (EventEmitter, ModelDeltaEvent,
                                         ModelSwapEvent)
@@ -374,6 +375,13 @@ class ModelRegistry:
             # delta rollback keeps the same full-model version live: the
             # health baseline is carried, exactly like a delta publish
             self._run_swap_hooks(version, "rollback")
+        # a rollback IS the postmortem moment: flush the flight ring so
+        # the window that led here (gate trips, stale deltas, the
+        # operator action) is on disk in every process that executes one
+        # — publishers directly, replicas when they replay the record
+        flight.trigger("model.rollback", version=str(version),
+                       kind="delta_rollback" if reverted else "rollback",
+                       degraded=degraded)
         return version
 
     def replay_row_state(self, restored: dict, version: str,

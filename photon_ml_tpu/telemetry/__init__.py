@@ -38,19 +38,20 @@ package (PhaseTimings / `timings.clock()`), never raw
 from photon_ml_tpu.telemetry.core import (  # noqa: F401
     MAX_RECORDS, NOOP_SPAN, SpanRecord, Tracer, active_tracer, armed,
     current_span_id, enabled, event, install, last_tracer, pop, push,
-    retrace_count, shutdown, span,
+    retrace_count, set_observer, shutdown, span,
 )
 from photon_ml_tpu.telemetry.export import (  # noqa: F401
     CHROME_REQUIRED_KEYS, chrome_trace_events, prometheus_text,
-    validate_chrome_trace,
+    render_prometheus_snapshot, validate_chrome_trace,
 )
 from photon_ml_tpu.telemetry.export import (
     write_chrome_trace as _write_chrome_trace,
 )
 from photon_ml_tpu.telemetry.metrics import (  # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
-    gauge, histogram,
+    Counter, Gauge, Histogram, LabeledCounter, MetricsRegistry, counter,
+    default_registry, gauge, histogram,
 )
+from photon_ml_tpu.telemetry import distributed, events, flight  # noqa: F401
 from photon_ml_tpu.telemetry.timings import PhaseTimings, clock  # noqa: F401
 
 # collectors: named callables whose dict results ride along in snapshot()

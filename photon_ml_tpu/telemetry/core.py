@@ -143,14 +143,22 @@ class _CompileWatch(logging.Handler):
 
 class Tracer:
     """One armed tracing session.  Created/installed via
-    `telemetry.install()`; all recording methods are thread-safe."""
+    `telemetry.install()`; all recording methods are thread-safe.
+
+    `proc` is the process's ROLE label ("train", "front", "replica",
+    "publisher", ...) — multi-process trace merging (`telemetry.
+    distributed`) keys per-process timelines on the (proc, pid) pair the
+    run log's leading `meta` record carries."""
 
     def __init__(self, run_log: Optional[str] = None,
                  watch_compiles: bool = True,
                  registry: Optional[_metrics.MetricsRegistry] = None,
-                 max_records: int = MAX_RECORDS):
+                 max_records: int = MAX_RECORDS,
+                 proc: Optional[str] = None):
         self.registry = registry or _metrics.default_registry()
         self.retrace_counter = self.registry.counter("jax.retraces")
+        self.proc = proc or "proc"
+        self.pid = os.getpid()
         self._t0 = time.perf_counter()
         self._wall0 = time.time()
         self._lock = threading.Lock()
@@ -167,7 +175,18 @@ class Tracer:
         if run_log is not None:
             d = os.path.dirname(os.path.abspath(run_log))
             os.makedirs(d, exist_ok=True)
-            self._run_log = open(run_log, "a", encoding="utf-8")
+            # LINE-buffered: a SIGKILLed process's log keeps every record
+            # written before the kill (the merge tool and the flight
+            # recorder exist precisely for those last seconds — a block-
+            # buffered tail would lose them)
+            self._run_log = open(run_log, "a", encoding="utf-8",
+                                 buffering=1)
+            # the merge tool anchors this process's perf-counter timeline
+            # (and names its Perfetto process track) from this record
+            self._log_record({
+                "kind": "meta", "name": "process_meta", "span": None,
+                "proc": self.proc, "pid": self.pid,
+                "wall0_unix_s": self._wall0})
         self._compile_watch = None
         self._compile_logger = None
         self._prev_log_compiles = None
@@ -281,13 +300,15 @@ class Tracer:
                 self.spans.append(record)
             else:
                 self.dropped += 1
-        self._log_record({
+        line = {
             "kind": "span", "name": record.name, "span": record.span_id,
             "parent": record.parent_id, "tid": record.tid,
             "thread": record.thread_name,
             "t0_s": round(record.t0, 6), "dur_s": round(record.dur_s, 6),
             "attrs": {k: _json_safe(v) for k, v in record.attrs.items()},
-        })
+        }
+        self._log_record(line)
+        self._notify_observer("span", line)
 
     def span(self, name: str, attrs: Optional[dict] = None) -> _Span:
         return _Span(self, name, attrs or {})
@@ -309,6 +330,16 @@ class Tracer:
             else:
                 self.dropped += 1
         self._log_record(record)
+        self._notify_observer("event", record)
+
+    def _notify_observer(self, kind: str, record: dict) -> None:
+        obs = _OBSERVER
+        if obs is None:
+            return
+        try:
+            obs(kind, record, self)
+        except Exception:  # an observer must never kill the traced code
+            pass
 
     # -- run log -----------------------------------------------------------
 
@@ -355,6 +386,7 @@ class Tracer:
                     "open_spans": self._open_count,
                     "dropped": self.dropped,
                     "run_log": self._run_log_path,
+                    "proc": self.proc,
                     "wall0_unix_s": self._wall0}
 
 
@@ -362,6 +394,20 @@ class Tracer:
 
 _ACTIVE: Optional[Tracer] = None
 _LAST: Optional[Tracer] = None   # kept for export after shutdown
+
+#: one process-global record observer (the flight recorder's tap): called
+#: as fn(kind, record_dict, tracer) on every closed span / instant event
+#: of whichever tracer is armed.  A plain module global, same disarm
+#: discipline as _ACTIVE — the armed hot path pays one None check.
+_OBSERVER = None
+
+
+def set_observer(fn) -> None:
+    """Install (or clear, with None) the process-global record observer.
+    Last-wins, like install(); telemetry.flight owns the only production
+    observer."""
+    global _OBSERVER
+    _OBSERVER = fn
 
 
 def active_tracer() -> Optional[Tracer]:
@@ -377,13 +423,14 @@ def armed() -> bool:
 
 
 def install(run_log: Optional[str] = None, watch_compiles: bool = True,
-            registry: Optional[_metrics.MetricsRegistry] = None) -> Tracer:
+            registry: Optional[_metrics.MetricsRegistry] = None,
+            proc: Optional[str] = None) -> Tracer:
     """Arm tracing process-globally; returns the Tracer.  An existing
     tracer is finished and replaced (last-wins, like faults.install_plan)."""
     global _ACTIVE, _LAST
     prev = _ACTIVE
     tracer = Tracer(run_log=run_log, watch_compiles=watch_compiles,
-                    registry=registry)
+                    registry=registry, proc=proc)
     _ACTIVE = tracer
     if prev is not None:
         prev.finish()
@@ -408,9 +455,10 @@ class enabled:
 
     def __init__(self, run_log: Optional[str] = None,
                  watch_compiles: bool = True,
-                 registry: Optional[_metrics.MetricsRegistry] = None):
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 proc: Optional[str] = None):
         self._kw = dict(run_log=run_log, watch_compiles=watch_compiles,
-                        registry=registry)
+                        registry=registry, proc=proc)
 
     def __enter__(self) -> Tracer:
         self.tracer = install(**self._kw)

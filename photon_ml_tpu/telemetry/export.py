@@ -115,35 +115,89 @@ def _prom_value(v) -> str:
     return repr(float(v)) if isinstance(v, float) else str(v)
 
 
-def prometheus_text(registry: MetricsRegistry,
-                    extra_info: Optional[Dict[str, str]] = None) -> str:
-    """Registry -> Prometheus exposition text (version 0.0.4).
-    `extra_info` renders as a `photon_info{k="v",...} 1` series (the
-    conventional carrier for e.g. the serving model version)."""
-    snap = registry.snapshot()
-    lines: List[str] = []
-    for name, value in snap["counters"].items():
+def _esc_label(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _label_str(labels: Optional[Dict[str, str]],
+               extra: Optional[Dict[str, str]] = None) -> str:
+    """{k: v} -> '{k="v",...}' (empty string for no labels)."""
+    merged: Dict[str, str] = {}
+    merged.update(labels or {})
+    merged.update(extra or {})
+    if not merged:
+        return ""
+    inner = ",".join(f'{_NAME_RE.sub("_", k)}="{_esc_label(v)}"'
+                     for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _parse_label_key(key: str) -> Dict[str, str]:
+    """A LabeledCounter snapshot key ('k=v,k2=v2') -> {k: v}.  Splits on
+    ',' then the FIRST '=' per segment — label values (replica URLs) may
+    contain '=' but never ','."""
+    out: Dict[str, str] = {}
+    for seg in key.split(","):
+        k, _, v = seg.partition("=")
+        out[k] = v
+    return out
+
+
+def render_prometheus_snapshot(snap: Dict[str, Dict],
+                               lines: List[str],
+                               labels: Optional[Dict[str, str]] = None,
+                               seen_types: Optional[set] = None) -> None:
+    """One registry SNAPSHOT -> exposition lines, every series stamped
+    with the constant `labels` (the federated surface's per-replica
+    `instance` label).  `seen_types` dedups `# TYPE` headers when several
+    snapshots of the same instrument family render into one page."""
+    seen = seen_types if seen_types is not None else set()
+
+    def typ(p: str, kind: str) -> None:
+        if p not in seen:
+            seen.add(p)
+            lines.append(f"# TYPE {p} {kind}")
+
+    lab = _label_str(labels)
+    for name, value in snap.get("counters", {}).items():
         p = _prom_name(name) + "_total"
-        lines.append(f"# TYPE {p} counter")
-        lines.append(f"{p} {_prom_value(value)}")
-    for name, value in snap["gauges"].items():
+        typ(p, "counter")
+        lines.append(f"{p}{lab} {_prom_value(value)}")
+    for name, value in snap.get("gauges", {}).items():
         p = _prom_name(name)
-        lines.append(f"# TYPE {p} gauge")
-        lines.append(f"{p} {_prom_value(value)}")
-    for name, h in snap["histograms"].items():
+        typ(p, "gauge")
+        lines.append(f"{p}{lab} {_prom_value(value)}")
+    for name, series in snap.get("labeled", {}).items():
+        p = _prom_name(name) + "_total"
+        typ(p, "counter")
+        for key, value in sorted(series.items()):
+            lines.append(f"{p}{_label_str(labels, _parse_label_key(key))} "
+                         f"{_prom_value(value)}")
+    for name, h in snap.get("histograms", {}).items():
         p = _prom_name(name)
-        lines.append(f"# TYPE {p} summary")
+        typ(p, "summary")
         for q, key in ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
                        (0.99, "p99")):
-            lines.append(f'{p}{{quantile="{q}"}} {_prom_value(h[key])}')
-        lines.append(f"{p}_sum {_prom_value(h['sum'])}")
-        lines.append(f"{p}_count {h['count']}")
+            lines.append(f"{p}{_label_str(labels, {'quantile': str(q)})} "
+                         f"{_prom_value(h[key])}")
+        lines.append(f"{p}_sum{lab} {_prom_value(h['sum'])}")
+        lines.append(f"{p}_count{lab} {h['count']}")
         if h["max"] is not None:
-            lines.append(f"# TYPE {p}_max gauge")
-            lines.append(f"{p}_max {_prom_value(h['max'])}")
+            typ(f"{p}_max", "gauge")
+            lines.append(f"{p}_max{lab} {_prom_value(h['max'])}")
+
+
+def prometheus_text(registry: MetricsRegistry,
+                    extra_info: Optional[Dict[str, str]] = None,
+                    labels: Optional[Dict[str, str]] = None) -> str:
+    """Registry -> Prometheus exposition text (version 0.0.4).
+    `extra_info` renders as a `photon_info{k="v",...} 1` series (the
+    conventional carrier for e.g. the serving model version);
+    `labels` stamps every series (the federated surface's instance
+    label)."""
+    lines: List[str] = []
+    render_prometheus_snapshot(registry.snapshot(), lines, labels=labels)
     if extra_info:
-        labels = ",".join(f'{_NAME_RE.sub("_", k)}="{v}"'
-                          for k, v in sorted(extra_info.items()))
         lines.append("# TYPE photon_info gauge")
-        lines.append(f"photon_info{{{labels}}} 1")
+        lines.append(f"photon_info{_label_str(extra_info)} 1")
     return "\n".join(lines) + "\n"

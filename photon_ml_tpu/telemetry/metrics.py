@@ -34,8 +34,9 @@ from typing import Dict, Optional
 
 from photon_ml_tpu.utils import locktrace
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "default_registry", "counter", "gauge", "histogram"]
+__all__ = ["Counter", "Gauge", "Histogram", "LabeledCounter",
+           "MetricsRegistry", "default_registry", "counter", "gauge",
+           "histogram"]
 
 
 class Counter:
@@ -162,6 +163,59 @@ class Histogram:
         return out
 
 
+class LabeledCounter:
+    """A FAMILY of counters distinguished by label values — the fleet
+    front's per-(replica, outcome) request accounting.  Children are
+    ordinary Counters created on first use of a label combination, so an
+    increment costs one dict lookup more than a plain counter; the label
+    cardinality is operator-bounded (replica URLs x a small outcome
+    enum), never per-request data.
+
+    Prometheus renders each child as `name_total{k="v",...}`; the JSON
+    snapshot renders the same children keyed by the canonical
+    `k=v,k2=v2` string — one series set on both surfaces, by
+    construction."""
+
+    __slots__ = ("name", "label_names", "_lock", "_children")
+
+    def __init__(self, name: str, label_names):
+        if not label_names:
+            raise ValueError(f"labeled counter {name!r} needs at least "
+                             "one label name (use a Counter otherwise)")
+        self.name = name
+        self.label_names = tuple(label_names)
+        self._lock = locktrace.tracked(threading.Lock(),
+                                       "LabeledCounter._lock")
+        self._children: Dict[tuple, Counter] = {}
+
+    def labels(self, **kv) -> Counter:
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"labeled counter {self.name!r} takes labels "
+                f"{list(self.label_names)}, got {sorted(kv)}")
+        key = tuple(str(kv[n]) for n in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Counter(self.name)
+                self._children[key] = child
+            return child
+
+    def inc(self, amount=1, **kv) -> None:
+        self.labels(**kv).inc(amount)
+
+    def series(self) -> Dict[tuple, object]:
+        """{label-value tuple (in label_names order): value}."""
+        with self._lock:
+            children = dict(self._children)
+        return {key: child.value for key, child in children.items()}
+
+    def snapshot(self) -> Dict[str, object]:
+        """{canonical "k=v,k2=v2" string: value} — the JSON surface."""
+        return {",".join(f"{n}={v}" for n, v in zip(self.label_names, key)):
+                value for key, value in sorted(self.series().items())}
+
+
 class MetricsRegistry:
     """Named instruments, created on first use; re-asking for a name
     returns the same instrument (asking with a different type raises —
@@ -193,21 +247,32 @@ class MetricsRegistry:
     def histogram(self, name: str, reservoir: int = 4096) -> Histogram:
         return self._get(name, Histogram, reservoir)
 
+    def labeled_counter(self, name: str, label_names) -> LabeledCounter:
+        inst = self._get(name, LabeledCounter, tuple(label_names))
+        if inst.label_names != tuple(label_names):
+            raise TypeError(
+                f"labeled counter {name!r} already registered with labels "
+                f"{list(inst.label_names)}, requested {list(label_names)}")
+        return inst
+
     def names(self):
         with self._lock:
             return sorted(self._instruments)
 
     def snapshot(self) -> Dict[str, Dict[str, object]]:
-        """{"counters": {...}, "gauges": {...}, "histograms": {...}} —
-        every value JSON-safe."""
+        """{"counters": {...}, "gauges": {...}, "histograms": {...},
+        "labeled": {...}} — every value JSON-safe."""
         with self._lock:
             items = list(self._instruments.items())
-        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        out = {"counters": {}, "gauges": {}, "histograms": {},
+               "labeled": {}}
         for name, inst in sorted(items):
             if isinstance(inst, Counter):
                 out["counters"][name] = inst.value
             elif isinstance(inst, Gauge):
                 out["gauges"][name] = inst.value
+            elif isinstance(inst, LabeledCounter):
+                out["labeled"][name] = inst.snapshot()
             else:
                 out["histograms"][name] = inst.snapshot()
         return out

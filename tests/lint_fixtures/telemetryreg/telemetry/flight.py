@@ -1,0 +1,21 @@
+"""PH008 violation fixture: a flight-recorder trigger registry that
+drifted from the telemetry event vocabulary, plus undisciplined
+trigger() call sites."""
+from photon_ml_tpu.telemetry import flight
+
+# "fixture.phantom" has no telemetry event constant in
+# telemetry/events.py -> registry-drift finding on this assignment
+TRIGGERS = {
+    "serve.drain": "SIGTERM graceful drain",
+    "fixture.phantom": "a trigger nobody declared an event for",
+}
+
+
+def fire_dynamic(reason):
+    # dynamic reason: plans/docs/greps cannot see what dumps exist
+    flight.trigger(reason, note="dynamic")
+
+
+def fire_unregistered():
+    # literal, but not in TRIGGERS above
+    flight.trigger("fixture.unregistered")

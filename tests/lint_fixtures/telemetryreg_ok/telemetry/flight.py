@@ -1,0 +1,14 @@
+"""PH008 compliant near-miss: the registry's names all have telemetry
+event constants, and every trigger() reason is a literal registered
+name."""
+from photon_ml_tpu.telemetry import flight
+
+TRIGGERS = {
+    "serve.drain": "SIGTERM graceful drain",
+    "serve.crash": "unhandled error on the serving path",
+}
+
+
+def fire():
+    flight.trigger("serve.drain", mode="standalone")
+    flight.trigger("serve.crash", error="boom")

@@ -1,0 +1,7 @@
+"""PH008 stale-entry fixture: an event constant whose fault site /
+flight trigger no longer exists anywhere."""
+
+EVENTS = {
+    "serve.drain": "flight_dump",
+    "ghost.trigger": "flight_dump",
+}

@@ -402,6 +402,66 @@ def test_fleet_smoke(tmp_path):
     assert scaling["throughput_ratio"] > 0
 
 
+def test_fleetobs_smoke(tmp_path):
+    """bench.py --fleetobs --smoke end-to-end in tier-1 (ISSUE 13
+    satellite): the fleet-observability harness — cross-process trace
+    merge (one connected tree per request id, feedback flow crossing
+    front -> publisher -> follower), clock-probe alignment keeping
+    children inside parents, federated per-replica lag that goes
+    0 -> >0 -> 0 around a SIGKILL + catch-up, correlated flight-recorder
+    bundles on the crash and on a health-gate trip, and the zero-fresh-
+    traces contract — cannot rot without failing the normal test run.
+    The armed-vs-disarmed p99 ratio is a smoke SIGNAL here (shared-core
+    CI); the full bench run gates it at 1.1x."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_fleetobs.json"
+    result = bench.fleetobs_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    fleet = next(e for e in detail["entries"]
+                 if e["name"] == "fleetobs_fleet")
+    # the merged Perfetto export validates and every sampled request id
+    # is ONE connected tree
+    assert fleet["merge_valid"] is True and fleet["merge_problems"] == []
+    assert fleet["score_trees_ok"] is True
+    # the feedback flow crosses >= 3 processes with the full span chain
+    assert fleet["feedback_tree_ok"] is True
+    assert {"front_request", "serve_request", "online_update",
+            "replica_apply"} <= set(fleet["feedback_tree"]["span_names"])
+    assert len(fleet["feedback_tree"]["processes"]) >= 3
+    # clock alignment keeps children inside their parents
+    assert fleet["containment"]["checked"] > 0
+    assert fleet["containment_violations"] == 0
+    # federated lag: 0 converged -> >0 while the follower is down and
+    # the publisher advances -> 0 after restart + catch-up
+    assert fleet["killed_returncode"] not in (0, 1)
+    assert fleet["lag_at_converged"]["lag_records"] == 0
+    assert fleet["lag_while_down"]["lag_records"] > 0
+    assert fleet["lag_after_catchup"]["lag_seq"] == 0
+    assert fleet["federated_ok"] is True
+    # the crash produced correlated bundles from >= 2 live processes
+    assert fleet["flight_ok"] is True
+    assert "front" in fleet["flight_bundle_procs"]
+    # a health-gate trip dumps the triggering window
+    health = next(e for e in detail["entries"]
+                  if e["name"] == "fleetobs_health_flight")
+    assert health["gate_trips"] >= 1
+    assert health["trip_event_in_bundle"] is True
+    assert health["evaluate_span_in_bundle"] is True
+    # zero fresh XLA traces armed AND disarmed
+    overhead = next(e for e in detail["entries"]
+                    if e["name"] == "fleetobs_overhead")
+    assert overhead["fresh_traces_disarmed"] == 0
+    assert overhead["fresh_traces_armed"] == 0
+    assert overhead["p99_ratio_armed_vs_disarmed"] > 0
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a

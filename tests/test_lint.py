@@ -34,6 +34,8 @@ CASES = [
     ("PH006", "ph006_violation.py", "ph006_compliant.py", 2),
     ("PH007", "hot/ops/ph007_violation.py",
      "hot/ops/ph007_compliant.py", 4),
+    ("PH008", "telemetryreg/telemetry/flight.py",
+     "telemetryreg_ok/telemetry/flight.py", 3),
     ("PH010", "concurrency/ph010_violation.py",
      "concurrency/ph010_compliant.py", 3),
     ("PH011", "concurrency/ph011_violation.py",
@@ -241,8 +243,10 @@ def test_ph004_registry_docs_drift(tmp_path):
         'SITES = {"stage.fetch": ("chunk",),\n'
         '         "undocumented.site": ()}\n')
     findings = lint_paths([str(tmp_path / "faults.py")])
-    assert [f.rule for f in findings] == ["PH004"]
-    assert "undocumented.site" in findings[0].message
+    # PH008 fires too: the synthetic site has no telemetry event constant
+    assert sorted(f.rule for f in findings) == ["PH004", "PH008"]
+    ph004 = next(f for f in findings if f.rule == "PH004")
+    assert "undocumented.site" in ph004.message
 
 
 def test_unparseable_module_is_reported_not_fatal(tmp_path):
@@ -319,8 +323,33 @@ def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule_id in ("PH001", "PH002", "PH003", "PH004", "PH005", "PH006",
-                    "PH007", "PH010", "PH011", "PH012", "PH013"):
+                    "PH007", "PH008", "PH010", "PH011", "PH012", "PH013"):
         assert rule_id in out
+
+
+def test_ph008_stale_event_constant_fixture():
+    """A telemetry event constant whose site/trigger no longer exists is
+    itself a drift finding (the registry diff cuts both ways)."""
+    findings = _lint("telemetryreg_stale/telemetry/events.py")
+    assert [f.rule for f in findings] == ["PH008"]
+    assert "ghost.trigger" in findings[0].message
+
+
+def test_ph008_package_registries_agree():
+    """ISSUE 13 satellite: the SHIPPED registries — utils.faults.SITES,
+    telemetry.flight.TRIGGERS, telemetry.events.EVENTS — agree exactly
+    (checked at runtime here, statically by photonlint in CI), and the
+    committed baseline carries no PH008 grandfathering."""
+    from photon_ml_tpu.telemetry.events import EVENTS
+    from photon_ml_tpu.telemetry.flight import TRIGGERS
+    from photon_ml_tpu.utils.faults import SITES
+    assert set(SITES) | set(TRIGGERS) == set(EVENTS), (
+        "telemetry/events.py EVENTS must cover every fault site and "
+        "flight trigger, with no stale extras")
+    findings = lint_paths([PACKAGE_DIR], select=["PH008"])
+    assert findings == []
+    baseline = Baseline.load(DEFAULT_BASELINE)
+    assert baseline.total == 0  # the committed baseline stays empty
 
 
 def test_cli_select_concurrency_gate():
