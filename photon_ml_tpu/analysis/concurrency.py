@@ -373,7 +373,16 @@ class _FunctionScan:
         if node is None:
             return
         if isinstance(node, ast.Lambda):
-            return  # deferred body, opaque receiver — out of scope
+            # a lambda is scanned AS IF invoked where it is built, with
+            # the locks lexically held there: the dominant package idiom
+            # is the immediately-run thunk (store.base.with_retries
+            # bodies, deferred builds), and a stored-callback lambda is a
+            # sound over-approximation (extra edges, never missed ones).
+            # Without this, a lock acquired inside a retried thunk has no
+            # static counterpart and the armed locktrace cross-validation
+            # reports a call-graph gap.
+            self._expr(node.body, held)
+            return
         if isinstance(node, ast.Call):
             self.info.calls.append(CallSite(node, node.lineno, tuple(held),
                                             self.info))
@@ -679,7 +688,10 @@ class ProgramContext:
                            ) -> Dict[str, Tuple[str, ...]]:
             if fi in memo:
                 return memo[fi]
-            if fi in stack or len(stack) > 4:
+            # depth cap: the tiered store's delta path legitimately nests
+            # registry -> scorer -> store -> commit -> stats (6 frames);
+            # the memo keeps the deeper bound cheap
+            if fi in stack or len(stack) > 7:
                 return {}
             out: Dict[str, Tuple[str, ...]] = {}
             for acq in fi.acquires:

@@ -22,7 +22,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 #: directory components whose modules are hot paths (PH001 applies)
 HOT_PATH_DIRS = ("ops", "optim", "game", "parallel", "serving", "online",
-                 "health", "fleet")
+                 "health", "fleet", "store")
 
 #: path suffixes of modules whose file writes must be durable (PH005);
 #: utils/durable.py is the helper implementation and is exempt
@@ -32,6 +32,7 @@ DURABLE_MODULE_SUFFIXES = (
     "data/index_map.py",
     "fleet/replog.py",
     "fleet/replica.py",
+    "store/cold.py",
 )
 DURABLE_IMPL_SUFFIX = "utils/durable.py"
 

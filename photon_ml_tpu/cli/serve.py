@@ -153,6 +153,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="delta undo-log bound; overflow drops the oldest "
                         "records LOUDLY and rollback degrades to a "
                         "full-model swap (serve.rollback_degraded)")
+    # -- tiered entity store ------------------------------------------------
+    p.add_argument("--store-budget-rows", type=int, default=None,
+                   metavar="N",
+                   help="serve random-effect tables through the tiered "
+                        "entity store with a device hot set of N rows "
+                        "(misses promote from the host warm tier / disk "
+                        "cold tier; requires --store-dir)")
+    p.add_argument("--store-dir", default=None, metavar="DIR",
+                   help="cold-tier directory for --store-budget-rows "
+                        "(sealed sha256-verified row segments; each "
+                        "installed version gets a subdirectory)")
+    p.add_argument("--store-warm-segments", type=int, default=64,
+                   help="host warm-tier budget in segments "
+                        "(x --store-seg-rows rows)")
+    p.add_argument("--store-seg-rows", type=int, default=16384,
+                   help="rows per cold segment file")
     # -- fleet: replica mode ------------------------------------------------
     p.add_argument("--replica", action="store_true",
                    help="run as a fleet replica: join from the "
@@ -240,7 +256,11 @@ def _build_service(args):
         min_bucket=args.min_bucket,
         default_timeout_s=(None if args.default_timeout_ms is None
                            else args.default_timeout_ms / 1e3),
-        max_delta_log=args.max_delta_log)
+        max_delta_log=args.max_delta_log,
+        store_budget_rows=args.store_budget_rows,
+        store_dir=args.store_dir,
+        store_warm_segments=args.store_warm_segments,
+        store_seg_rows=args.store_seg_rows)
     updates = None
     if args.enable_updates:
         from photon_ml_tpu.online import OnlineUpdateConfig

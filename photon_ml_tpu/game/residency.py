@@ -17,6 +17,16 @@ describes (arXiv:1803.06333):
      device blocks are EVICTED and re-streamed on its next visit (host
      copies kept by the out-of-core build, keep_host_blocks).
 
+The eviction MECHANISM lives in the tiered entity store
+(photon_ml_tpu/store/handles.py): every coordinate registers its
+evictable device blocks as a BlockStore handle at construction, and the
+rotation's fetch/evict transitions run through the store — the one
+eviction entry point shared with mesh staging and serving, with the
+`store.fetch` fault site + shared retry discipline on every re-stage and
+the unified store.* telemetry counters.  This manager keeps the POLICY:
+per-device budget math, the evict-inactive decision, and the peak
+accounting below.
+
 On a device mesh the budget is PER DEVICE: coordinate blocks shard their
 leading axis over the mesh "data" axis, so each device holds 1/D of every
 block and the manager accounts block bytes divided by D (flat [n] vectors
@@ -36,6 +46,8 @@ import logging
 import math
 from typing import Dict, Optional
 
+from photon_ml_tpu.store.handles import BlockStore
+
 logger = logging.getLogger("photon_ml_tpu")
 
 
@@ -49,7 +61,8 @@ class CoordinateFootprint:
 
 class ResidencyManager:
     """Tracks per-coordinate device footprints against the budget and runs
-    the eviction rotation inside run_coordinate_descent.
+    the eviction rotation inside run_coordinate_descent — through the
+    tiered store's block handles.
 
     `coordinates` is the built Coordinate map — each coordinate exposes
     `device_block_bytes()`, `evict_device_blocks()` and (for streamed FE)
@@ -72,16 +85,17 @@ class ResidencyManager:
             self.data_devices = max(int(mesh.shape.get(DATA_AXIS, 1)), 1)
         per_dev = lambda b: int(math.ceil(b / self.data_devices))
         self.footprints: Dict[str, CoordinateFootprint] = {}
-        self._coords = coordinates
+        self.store = BlockStore()
         for name, coord in coordinates.items():
             streamed = bool(getattr(coord, "streamed", False))
+            block_bytes = (0 if streamed
+                           else per_dev(int(coord.device_block_bytes())))
             self.footprints[name] = CoordinateFootprint(
-                name=name,
-                block_bytes=(0 if streamed
-                             else per_dev(int(coord.device_block_bytes()))),
-                streamed=streamed,
+                name=name, block_bytes=block_bytes, streamed=streamed,
                 chunk_bytes=(per_dev(int(coord.streaming_buffer_bytes()))
                              if streamed else 0))
+            self.store.register(name, evict=coord.evict_device_blocks,
+                                block_bytes=block_bytes, streamed=streamed)
         self.resident_block_total = sum(f.block_bytes
                                         for f in self.footprints.values())
         # a streamed coordinate's double buffer is live during ITS update,
@@ -112,8 +126,11 @@ class ResidencyManager:
     # -- descent-loop hooks ---------------------------------------------------
     def before_update(self, name: str) -> None:
         """Coordinate `name` is about to update: its blocks re-stream on
-        first touch — count them resident from here."""
+        first touch — count them resident from here.  An evicted
+        coordinate's re-fetch goes through the store (store.fetch site,
+        retry discipline, store.* counters)."""
         f = self.footprints[name]
+        self.store.touch(name)
         self._resident[name] = (f.chunk_bytes if f.streamed
                                 else f.block_bytes)
         current = (sum(self._resident.values()) + self.flat_vector_bytes)
@@ -121,8 +138,9 @@ class ResidencyManager:
 
     def after_update(self, name: str) -> None:
         """Coordinate `name` finished update+score (+objective): under
-        budget pressure its device blocks are dropped NOW; the next visit's
-        lazy accessors re-stream them."""
+        budget pressure its device blocks are dropped NOW through the
+        store's eviction entry point; the next visit's lazy accessors
+        re-stream them."""
         f = self.footprints[name]
         if f.streamed:
             # chunks are released by the prefetcher as the pass drains;
@@ -131,7 +149,7 @@ class ResidencyManager:
             return
         if not self.evict_inactive:
             return
-        self._coords[name].evict_device_blocks()
+        self.store.evict(name)
         self._resident.pop(name, None)
         self.evictions += 1
 
@@ -156,4 +174,5 @@ class ResidencyManager:
             "peak_tracked_bytes": self.peak_tracked_bytes,
             "under_budget": (self.budget_bytes is None
                              or self.peak_tracked_bytes <= self.budget_bytes),
+            "store": self.store.snapshot(),
         }

@@ -29,15 +29,22 @@ Keys are tuples — typically ``(coordinate_name, id(coordinate))`` plus an
 optional sub-key (an entity bucket's lane start, "latent", "kron") — and
 `invalidate(prefix)` drops every entry whose key starts with the prefix:
 evicting one coordinate no longer drops every other coordinate's staged
-blocks (the old `clear_mesh_block_cache` sledgehammer, kept as a deprecated
-alias over `clear()`).
+blocks.  (The deprecated `clear_mesh_block_cache` global-flush alias is
+RETIRED: invalidation routes through the tiered store's residency
+registry.)
+
+This module is a TENANT of the tiered entity store
+(photon_ml_tpu/store/): the keyed registry semantics — identity
+staleness, bounded FIFO, prefix invalidation — live in
+`store.handles.ResidencyRegistry`, and every transfer runs under the
+store's shared `with_retries` discipline.  What stays here is the
+mesh-specific staging (pad + shard + sharding specs) and the cold/warm
+byte split.
 """
 from __future__ import annotations
 
-import collections
 import random
 import threading
-import time
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -48,15 +55,9 @@ from photon_ml_tpu import telemetry
 from photon_ml_tpu.parallel.mesh import (
     DATA_AXIS, data_sharding, feature_sharding, replicated,
 )
-from photon_ml_tpu.utils import faults, locktrace
-
-# staging retry policy — mirrors data/streaming.py's Prefetcher: a flaky
-# host read / device transfer must not kill a long fit; transient failures
-# (faults.is_transient) retry with jittered exponential backoff, fatal ones
-# (and always KeyboardInterrupt/SystemExit) propagate immediately.
-STAGE_MAX_ATTEMPTS = 3
-STAGE_BACKOFF_S = 0.05
-STAGE_BACKOFF_JITTER = 0.5
+from photon_ml_tpu.store.base import with_retries
+from photon_ml_tpu.store.handles import ResidencyRegistry
+from photon_ml_tpu.utils import locktrace
 
 
 class MeshStagingError(RuntimeError):
@@ -196,7 +197,8 @@ def _as_tuple(key) -> tuple:
 
 
 class MeshResidency:
-    """Keyed registry of padded + sharded STATIC coordinate arrays.
+    """Keyed registry of padded + sharded STATIC coordinate arrays — a
+    tenant of the tiered store's ResidencyRegistry.
 
     An entry is keyed ``(coordinate key, field, mesh fingerprint)`` and
     pins the SOURCE array it was staged from: a call with a different
@@ -208,45 +210,33 @@ class MeshResidency:
     def __init__(self, max_entries: int = 256):
         self.max_entries = max_entries
         self.stats = TransferStats()
-        self._entries: "collections.OrderedDict" = collections.OrderedDict()
-        self._lock = locktrace.tracked(threading.Lock(),
-                                       "MeshResidency._lock")
+        self._registry = ResidencyRegistry(
+            max_entries=max_entries,
+            on_eviction=self.stats.note_eviction,
+            on_invalidation=self.stats.note_invalidation,
+            prefix_key=lambda k: k[0])
         self._jitter = random.Random(0)
 
     # -- staging --------------------------------------------------------------
     def _transfer_with_retry(self, mesh, host_or_build, fill, spec,
                              key, field, warm: bool):
-        """One staged transfer under the Prefetcher's transient/fatal
-        discipline; `host_or_build` is the array or a zero-arg callable
-        producing it (deferred so a retry re-reads the source)."""
-        attempt = 0
-        while True:
-            attempt += 1
-            try:
-                faults.fire("mesh.stage", key=str(key), field=field)
-                with telemetry.span("mesh_stage", key=str(key), field=field,
-                                    warm=warm):
-                    src = (host_or_build() if callable(host_or_build)
-                           else host_or_build)
-                    staged, nbytes = _stage_tree(mesh, src, fill, spec)
-                self.stats.note_stage(nbytes, warm=warm)
-                return staged, nbytes
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except BaseException as e:
-                if not faults.is_transient(e):
-                    raise MeshStagingError(
-                        f"mesh staging failed for {key!r}/{field} (fatal "
-                        f"{type(e).__name__}, not retryable)") from e
-                if attempt >= STAGE_MAX_ATTEMPTS:
-                    raise MeshStagingError(
-                        f"mesh staging failed for {key!r}/{field} after "
-                        f"{attempt} attempt(s)") from e
-                self.stats.note_retry()
-                delay = (STAGE_BACKOFF_S * (2 ** (attempt - 1))
-                         * (1.0 + STAGE_BACKOFF_JITTER
-                            * self._jitter.random()))
-                time.sleep(delay)
+        """One staged transfer under the store's shared transient/fatal
+        retry discipline; `host_or_build` is the array or a zero-arg
+        callable producing it (deferred so a retry re-reads the source)."""
+
+        def stage():
+            with telemetry.span("mesh_stage", key=str(key), field=field,
+                                warm=warm):
+                src = (host_or_build() if callable(host_or_build)
+                       else host_or_build)
+                staged, nbytes = _stage_tree(mesh, src, fill, spec)
+            self.stats.note_stage(nbytes, warm=warm)
+            return staged, nbytes
+
+        return with_retries(
+            stage, site="mesh.stage", what=f"{key!r}/{field}",
+            on_retry=self.stats.note_retry, jitter=self._jitter,
+            error_cls=MeshStagingError, key=str(key), field=field)
 
     def stage_static(self, key, field: str, mesh, source, fill=0.0, *,
                      build: Optional[Callable[[], object]] = None,
@@ -261,23 +251,15 @@ class MeshResidency:
         if source is None:
             return None
         full_key = (_as_tuple(key), field, _mesh_fingerprint(mesh))
-        with self._lock:
-            entry = self._entries.get(full_key)
-            if entry is not None and entry[0] is source:
-                self._entries.move_to_end(full_key)
-                return entry[1]
-            replacing = entry is not None
+        staged, replacing = self._registry.lookup(full_key, source)
+        if staged is not None:
+            return staged
         staged, _ = self._transfer_with_retry(
             mesh, build if build is not None else source, fill, spec,
             key, field, warm=False)
-        with self._lock:
-            if replacing:
-                self.stats.note_invalidation()
-            self._entries[full_key] = (source, staged)
-            self._entries.move_to_end(full_key)
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
-                self.stats.note_eviction()
+        if replacing:
+            self.stats.note_invalidation()
+        self._registry.commit(full_key, source, staged)
         return staged
 
     def stage_update(self, mesh, array, fill=0.0, *, spec: str = "data",
@@ -296,31 +278,16 @@ class MeshResidency:
         """Drop every entry whose coordinate key starts with `key` (all
         fields, all meshes).  The residency manager's per-coordinate
         eviction hook — other coordinates' staged blocks are untouched."""
-        prefix = _as_tuple(key)
-        with self._lock:
-            doomed = [k for k in self._entries
-                      if k[0][: len(prefix)] == prefix]
-            for k in doomed:
-                del self._entries[k]
-        if doomed:
-            self.stats.note_invalidation(len(doomed))
-        return len(doomed)
+        return self._registry.invalidate(_as_tuple(key))
 
     def clear(self) -> int:
-        with self._lock:
-            n = len(self._entries)
-            self._entries.clear()
-        if n:
-            self.stats.note_invalidation(n)
-        return n
+        return self._registry.clear()
 
     def num_entries(self) -> int:
-        with self._lock:
-            return len(self._entries)
+        return self._registry.num_entries()
 
     def keys(self) -> Tuple[tuple, ...]:
-        with self._lock:
-            return tuple(self._entries)
+        return self._registry.keys()
 
 
 # -- process-global default registry ------------------------------------------

@@ -110,16 +110,6 @@ def _cached_batched_solver(loss: PointwiseLoss, config: OptimizerConfig,
                    donate_argnums=(5,) if donate else ())
 
 
-def clear_mesh_block_cache() -> None:
-    """DEPRECATED global flush: drops EVERY coordinate's memoized sharded
-    arrays from the mesh residency layer.  Eviction now invalidates per
-    coordinate (`mesh_residency.invalidate(key)` — what the HBM residency
-    manager's hooks call); this alias remains for callers that still want
-    the sledgehammer."""
-    from photon_ml_tpu.parallel.mesh_residency import clear
-    clear()
-
-
 def fit_random_effects(
     blocks: EntityBlocks,
     loss: PointwiseLoss,

@@ -105,6 +105,11 @@ SNAPSHOT_PATHS = {
     "health.delta_l2_mean": ("health", "delta_l2_mean"),
     "health.delta_l2_max": ("health", "delta_l2_max"),
     "health.freezes_window": ("health", "freezes_window"),
+    "store.hot_hits": ("store", "hot_hits"),
+    "store.warm_hits": ("store", "warm_hits"),
+    "store.cold_misses": ("store", "cold_misses"),
+    "store.promotions": ("store", "promotions"),
+    "store.spills": ("store", "spills"),
     "fleet.applied_seq": ("fleet", "applied_seq"),
     "fleet.lag_seq": ("fleet", "lag_seq"),
     "fleet.lag_seconds": ("fleet", "lag_seconds"),
@@ -213,6 +218,19 @@ class ServingMetrics:
         self._health_delta_mean = r.gauge("health.delta_l2_mean")
         self._health_delta_max = r.gauge("health.delta_l2_max")
         self._health_freezes = r.gauge("health.freezes_window")
+        # -- tiered entity store (photon_ml_tpu/store/) ----------------------
+        # scorer miss accounting: a row lookup served device-resident
+        # (hot), one promoted out of the host warm tier, one that needed
+        # a cold segment read — plus tier movements.  Counters sync to
+        # the store's cumulative totals at render time on BOTH surfaces
+        # (the set_store_probe discipline); all zeros when the model is
+        # fully resident.
+        self._store_hot = r.counter("store.hot_hits")
+        self._store_warm = r.counter("store.warm_hits")
+        self._store_cold = r.counter("store.cold_misses")
+        self._store_promotions = r.counter("store.promotions")
+        self._store_spills = r.counter("store.spills")
+        self._store_probe = None
         # -- replicated-serving tier (photon_ml_tpu/fleet/) ------------------
         # replica-side replication vitals (all zeros outside --replica
         # mode — the same exists-either-way contract as online./health.*);
@@ -398,6 +416,36 @@ class ServingMetrics:
         with self._lock:
             self._online_probe = fn
 
+    # -- tiered entity store -------------------------------------------------
+
+    def set_store_probe(self, fn) -> None:
+        """`fn() -> {"hot_hits": int, "warm_hits": int, ...}` — the live
+        scorer's cumulative tier totals (CompiledScorer.store_totals),
+        synced into the counters on BOTH render paths."""
+        with self._lock:
+            self._store_probe = fn
+
+    def _refresh_store_counters(self) -> None:
+        """Sync the store.* counters to the probe's cumulative totals
+        (monotonic: a model swap resets the scorer's totals, never the
+        counters)."""
+        with self._lock:
+            probe = self._store_probe
+        if probe is None:
+            return
+        try:
+            totals = probe()
+        except Exception:
+            return  # a swapping scorer must not take the scrape down
+        for counter, key in ((self._store_hot, "hot_hits"),
+                             (self._store_warm, "warm_hits"),
+                             (self._store_cold, "cold_misses"),
+                             (self._store_promotions, "promotions"),
+                             (self._store_spills, "spills")):
+            gap = int(totals.get(key, 0)) - counter.value
+            if gap > 0:
+                counter.inc(gap)
+
     # -- model-health tier ---------------------------------------------------
 
     @staticmethod
@@ -475,6 +523,7 @@ class ServingMetrics:
 
     def snapshot(self, model_version: Optional[str] = None) -> Dict:
         self._refresh_online_gauges()
+        self._refresh_store_counters()
         with self._lock:
             batches = self._batches.value
             bucket_rows = self._bucket_rows.value
@@ -521,6 +570,7 @@ class ServingMetrics:
         out["model_age_s"] = round(self._refresh_model_age(), 3)
         out["online"] = self._online_snapshot()
         out["health"] = self._health_snapshot()
+        out["store"] = self._store_snapshot()
         out["fleet"] = self._fleet_snapshot()
         if model_version is not None:
             out["model_version"] = model_version
@@ -595,6 +645,23 @@ class ServingMetrics:
             "freezes_window": self._health_freezes.value,
         }
 
+    def _store_snapshot(self) -> Dict:
+        """The tiered entity store's state (all zeros when the model is
+        fully resident — the instruments exist either way).  `hit_rate`
+        is the derived hot fraction of all row lookups."""
+        hot = self._store_hot.value
+        warm = self._store_warm.value
+        cold = self._store_cold.value
+        lookups = hot + warm + cold
+        return {
+            "hot_hits": hot,
+            "warm_hits": warm,
+            "cold_misses": cold,
+            "promotions": self._store_promotions.value,
+            "spills": self._store_spills.value,
+            "hit_rate": round(hot / lookups, 4) if lookups else None,
+        }
+
     @staticmethod
     def _latency_ms(h: Dict) -> Optional[Dict]:
         if not h["count"]:
@@ -629,5 +696,6 @@ class ServingMetrics:
         refresh here as on the JSON surface."""
         self._refresh_model_age()
         self._refresh_online_gauges()
+        self._refresh_store_counters()
         info = {"model_version": model_version} if model_version else None
         return prometheus_text(self.registry, extra_info=info)

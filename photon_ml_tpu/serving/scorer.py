@@ -15,6 +15,18 @@ contribute score 0, so such rows fall back to fixed-effect-only scores
 exactly like the offline path (reference: the missing-score default,
 Evaluator.scala:35-45).
 
+Models past the device budget serve through the tiered entity store
+(`store=StoreConfig(...)`): each random-effect table lives in a
+photon_ml_tpu.store.TieredEntityStore — a device-resident HOT subset the
+bucket programs gather from by slot, a host warm tier, and sealed cold
+segments on disk.  A request chunk's misses ride the chunk's own device
+transfer as a per-batch staging window (its lanes gather from a second
+traced table argument), so a miss never compiles anything or copies the
+hot table; promotion into the hot set is amortized in the store.  Online
+deltas land in whatever tier a row lives in and feedback for cold
+entities promotes them; tiered scores are bit-identical to the
+fully-resident scorer's.
+
 Scoring semantics match `GameModel.score_dataset`: the returned value is
 the summed margin contribution of every coordinate, WITHOUT offsets or the
 inverse link (`mean_prediction` applies the link when callers want means).
@@ -107,7 +119,8 @@ class CompiledScorer:
     """
 
     def __init__(self, model: GameModel, *, max_batch: int = 1024,
-                 min_bucket: int = 8, version: Optional[str] = None):
+                 min_bucket: int = 8, version: Optional[str] = None,
+                 store=None, store_dir: Optional[str] = None):
         if max_batch < 1 or min_bucket < 1:
             raise ValueError("max_batch and min_bucket must be >= 1")
         self.model = model
@@ -115,6 +128,20 @@ class CompiledScorer:
         self.max_batch = int(ceil_pow2(max_batch))
         self.min_bucket = min(int(ceil_pow2(min_bucket)), self.max_batch)
         self._loss = L.TASK_LOSSES.get(model.task_type)
+        # tiered-store serving (photon_ml_tpu/store/): every RE table
+        # lives behind a TieredEntityStore instead of fully device-resident
+        if store is not None and store_dir is None:
+            raise ValueError("store=StoreConfig(...) requires store_dir "
+                             "(the cold tier's segment directory)")
+        if store is not None and store.overlay_rows < self.max_batch:
+            raise ValueError(
+                f"store overlay_rows ({store.overlay_rows}) must cover "
+                f"the largest scoring chunk (max_batch={self.max_batch}):"
+                " a single batch could otherwise miss more distinct rows "
+                "than the staging overlay holds")
+        self._store_config = store
+        self._store_dir = store_dir
+        self._stores: Dict[str, object] = {}
 
         # static program structure (baked into _compute) + device tables
         self._fe_meta: List[Tuple[str, str]] = []          # (name, shard)
@@ -122,6 +149,7 @@ class CompiledScorer:
         self._mf_meta: List[Tuple[str, str, str]] = []     # (name, row_t, col_t)
         self._lookups: Dict[str, dict] = {}                # lane key -> id map
         self._table_slot: Dict[str, int] = {}              # RE name -> slot
+        self._overlay_slot: Dict[str, int] = {}            # store coord -> slot
         tables = []
         shard_dims: Dict[str, int] = {}
 
@@ -142,13 +170,37 @@ class CompiledScorer:
                 # stacked per-entity table in the ORIGINAL shard space:
                 # projected/factored coordinates materialize P^T c once at
                 # load so serving is a single gather + row dot per request
-                table = jnp.asarray(m.global_coefficients())
-                note_shard(m.feature_shard, table.shape[-1], name)
-                self._re_meta.append((name, m.feature_shard,
-                                      m.random_effect_type))
-                self._lookups[name] = _id_lookup(m.entity_ids)
-                self._table_slot[name] = len(tables)
-                tables.append(table)
+                if store is not None:
+                    import os
+                    from photon_ml_tpu.store import TieredEntityStore
+                    table_np = np.asarray(m.global_coefficients())
+                    note_shard(m.feature_shard, table_np.shape[-1], name)
+                    st = TieredEntityStore.create(
+                        os.path.join(store_dir, name.replace("/", "_")),
+                        table_np, store,
+                        entity_ids=np.asarray(m.entity_ids), name=name)
+                    self._stores[name] = st
+                    self._re_meta.append((name, m.feature_shard,
+                                          m.random_effect_type))
+                    self._table_slot[name] = len(tables)
+                    tables.append(st.table())
+                    # the staging window rides as its own traced table:
+                    # a batch's missed-row values score out of it (built
+                    # host-side per batch, shipped with the batch's own
+                    # transfer) while promotion into the main hot table
+                    # stays amortized.  The entry here is a placeholder
+                    # pinning the static [overlay_rows, d] shape.
+                    self._overlay_slot[name] = len(tables)
+                    tables.append(jnp.zeros((st.overlay_rows, st.dim),
+                                            st.dtype))
+                else:
+                    table = jnp.asarray(m.global_coefficients())
+                    note_shard(m.feature_shard, table.shape[-1], name)
+                    self._re_meta.append((name, m.feature_shard,
+                                          m.random_effect_type))
+                    self._lookups[name] = _id_lookup(m.entity_ids)
+                    self._table_slot[name] = len(tables)
+                    tables.append(table)
             elif isinstance(m, MatrixFactorizationModel):
                 self._mf_meta.append((name, m.row_effect_type,
                                       m.col_effect_type))
@@ -189,11 +241,12 @@ class CompiledScorer:
     @classmethod
     def from_model_dir(cls, model_dir: str, *, max_batch: int = 1024,
                        min_bucket: int = 8, version: Optional[str] = None,
-                       warmup: bool = True) -> "CompiledScorer":
+                       warmup: bool = True, store=None,
+                       store_dir: Optional[str] = None) -> "CompiledScorer":
         from photon_ml_tpu.models.io import load_game_model
         model, _config = load_game_model(model_dir)
         scorer = cls(model, max_batch=max_batch, min_bucket=min_bucket,
-                     version=version)
+                     version=version, store=store, store_dir=store_dir)
         if warmup:
             scorer.warmup()
         return scorer
@@ -206,14 +259,29 @@ class CompiledScorer:
         out.append(self.max_batch)
         return out
 
+    def _lane_names(self) -> List[str]:
+        names = []
+        for name, _, _ in self._re_meta:
+            names.append(name)
+            if name in self._stores:
+                names.append(name + "@stage")
+        names += [name + side for name, _, _ in self._mf_meta
+                  for side in ("/row", "/col")]
+        return names
+
     def warmup(self) -> float:
-        """Compile every bucket program now, so no request ever does."""
+        """Compile every bucket program now, so no request ever does.
+        Store-backed tables also pre-compile their promotion/delta
+        scatter shapes, so steady-state misses trace nothing either."""
         t0 = clock()
         with telemetry.span("serve_warmup", version=self.version):
+            for st in self._stores.values():
+                st.warmup()
             for b in self.bucket_sizes():
                 xs = {s: np.zeros((b, d), np.float64)
                       for s, d in self.feature_shards.items()}
-                lanes = {k: np.full(b, -1, np.int32) for k in self._lookups}
+                lanes = {k: np.full(b, -1, np.int32)
+                         for k in self._lane_names()}
                 jax.block_until_ready(self._run_bucket(xs, lanes, b))
         self.warmup_s = clock() - t0
         self.warmed = True
@@ -238,6 +306,13 @@ class CompiledScorer:
         for name, shard, _re_type in self._re_meta:
             table = tables[i]; i += 1
             add(score_by_entity(table, xs[shard], lanes[name]))
+            if name in self._stores:
+                # tiered coordinate: a row lives in EXACTLY one of the
+                # main hot table / staging overlay (the other lane is
+                # -1 -> contributes 0), so the sum is the full margin
+                overlay = tables[i]; i += 1
+                add(score_by_entity(overlay, xs[shard],
+                                    lanes[name + "@stage"]))
         for name, _row_t, _col_t in self._mf_meta:
             rf, cf = tables[i], tables[i + 1]; i += 2
             rl, cl = lanes[name + "/row"], lanes[name + "/col"]
@@ -247,13 +322,34 @@ class CompiledScorer:
             add(jnp.where(ok, jnp.sum(rfa * cfa, axis=-1), 0.0))
         return total
 
-    def _run_bucket(self, xs, lanes, bucket: int):
+    def _run_bucket(self, xs, lanes, bucket: int, store_tables=None):
         if bucket not in self._seen_buckets:
             self._seen_buckets.add(bucket)
             self.bucket_compiles += 1
-        xs = {s: jnp.asarray(x, self._dtype) for s, x in xs.items()}
-        lanes = {k: jnp.asarray(v) for k, v in lanes.items()}
-        return self._program(self._tables, xs, lanes)
+        # ONE batched host->device transfer for every feature shard,
+        # lane array, and staged-miss window (per-array dispatch
+        # overhead dominates small-batch serving latency on weak hosts;
+        # the dtype cast stays host-side)
+        np_dtype = np.dtype(self._dtype)
+        windows = {name: w for name, (_t, w) in store_tables.items()} \
+            if store_tables else {}
+        xs, lanes, windows = jax.device_put((
+            {s: np.asarray(x, np_dtype) for s, x in xs.items()},
+            {k: np.asarray(v) for k, v in lanes.items()},
+            windows))
+        tables = self._tables
+        if store_tables:
+            # tiered mode: each chunk scores against the EXACT hot-table
+            # snapshot its slots were resolved into (batch-granularity
+            # consistency — a concurrent promotion replaces the store's
+            # table functionally, never mutating this snapshot) plus its
+            # own private staging window
+            t = list(tables)
+            for name, (table, _w) in store_tables.items():
+                t[self._table_slot[name]] = table
+                t[self._overlay_slot[name]] = windows[name]
+            tables = tuple(t)
+        return self._program(tables, xs, lanes)
 
     # -- online row-level updates ------------------------------------------
 
@@ -265,31 +361,64 @@ class CompiledScorer:
         return list(self._re_meta)
 
     def re_table(self, name: str) -> jax.Array:
-        """The device-resident stacked [E, d] table of one RE coordinate
-        (original shard space — what apply_delta scatters into)."""
+        """The device-resident stacked table of one RE coordinate
+        (original shard space — what apply_delta scatters into; in tiered
+        mode this is the HOT subset, addressed by slot)."""
+        st = self._stores.get(name)
+        if st is not None:
+            return st.table()
         return self._tables[self._table_slot[name]]
 
     def entity_row(self, name: str, entity_id) -> int:
         """Table row of a raw entity id under coordinate `name`
         (-1 = unseen at training time; such entities cannot be
         online-updated — the table has no row to anchor at)."""
+        st = self._stores.get(name)
+        if st is not None:
+            return st.resolve_one(entity_id)
         return self._lookups[name].get(entity_id, -1)
 
+    def entity_store(self, name: str):
+        """The TieredEntityStore behind one coordinate (None when the
+        table is fully device-resident)."""
+        return self._stores.get(name)
+
+    @property
+    def tiered(self) -> bool:
+        return bool(self._stores)
+
     def gather_rows(self, name: str, rows: np.ndarray) -> jax.Array:
-        """Device gather of table rows (delta priors / anchors)."""
+        """Gather of table rows (delta priors / anchors).  Tiered mode
+        reads the authoritative warm/cold bytes host-side — bit-exact
+        with what the hot tier serves."""
+        st = self._stores.get(name)
+        if st is not None:
+            return jnp.asarray(st.gather_rows(np.asarray(rows, np.int64)))
         return _gather_rows(self.re_table(name),
                             jnp.asarray(np.asarray(rows, np.int64)))
 
     def _scatter_coordinate(self, name: str, rows: np.ndarray,
-                            values: np.ndarray) -> None:
+                            values: np.ndarray,
+                            promote: bool = False) -> None:
         slot = self._table_slot.get(name)
         if slot is None:
             known = sorted(self._table_slot)
             raise KeyError(f"coordinate {name!r} has no online-updatable "
                            f"table (updatable: {known})")
-        table = self._tables[slot]
         rows = np.asarray(rows, np.int64)
         values = np.asarray(values)
+        st = self._stores.get(name)
+        if st is not None:
+            # tiered mode: the delta lands in whatever tier each row
+            # lives in (warm always, hot write-through for resident rows,
+            # promote=True pulls cold rows hot — the feedback path)
+            if values.shape != (len(rows), st.dim):
+                raise ValueError(
+                    f"delta values for {name!r} must be [{len(rows)}, "
+                    f"{st.dim}], got {values.shape}")
+            st.update_rows(rows, values, promote=promote)
+            return
+        table = self._tables[slot]
         if values.shape != (len(rows), table.shape[1]):
             raise ValueError(
                 f"delta values for {name!r} must be [{len(rows)}, "
@@ -326,6 +455,13 @@ class CompiledScorer:
         t0 = clock()
         with telemetry.span("replica_delta_warmup", version=self.version):
             for name, _shard, _re_type in self.updatable_coordinates():
+                st = self._stores.get(name)
+                if st is not None:
+                    # tiered tables replay deltas through the store's own
+                    # pre-jitted scatter shapes
+                    if not st.warmed:
+                        st.warmup()
+                    continue
                 table = self.re_table(name)
                 k = 1
                 bound = int(ceil_pow2(max(max_rows, 1)))
@@ -355,10 +491,20 @@ class CompiledScorer:
                 .tobytes()).hexdigest()
             i += 1
         for name, _shard, _re_type in self._re_meta:
-            out[name] = hashlib.sha256(
-                np.ascontiguousarray(np.asarray(self._tables[i]))
-                .tobytes()).hexdigest()
-            i += 1
+            st = self._stores.get(name)
+            if st is not None:
+                # tiered mode hashes the LOGICAL table (cold + warm
+                # overlay): two replicas whose tiering histories differ
+                # but whose row values agree hash identically
+                out[name] = hashlib.sha256(
+                    np.ascontiguousarray(st.full_table())
+                    .tobytes()).hexdigest()
+                i += 2          # main hot table + staging overlay
+            else:
+                out[name] = hashlib.sha256(
+                    np.ascontiguousarray(np.asarray(self._tables[i]))
+                    .tobytes()).hexdigest()
+                i += 1
         for name, _row_t, _col_t in self._mf_meta:
             for side in ("/row", "/col"):
                 out[name + side] = hashlib.sha256(
@@ -371,19 +517,55 @@ class CompiledScorer:
         """Scatter a ModelDelta's changed rows into the live tables.
         Callers serialize through the registry lock; scoring threads need
         no lock (the table tuple swap is atomic, and the compiled bucket
-        programs take tables as traced ARGUMENTS, so no re-trace)."""
+        programs take tables as traced ARGUMENTS, so no re-trace).
+        Tiered tables land the rows in whatever tier they live in, and
+        PROMOTE cold rows hot — an entity the traffic cares enough about
+        to send feedback for belongs in the hot set."""
         for name, cd in delta.coordinates.items():
-            self._scatter_coordinate(name, cd.rows, cd.values)
+            self._scatter_coordinate(name, cd.rows, cd.values,
+                                     promote=True)
         self.delta_seq = delta.seq
         self.deltas_applied += 1
 
     def revert_delta(self, delta) -> None:
         """Scatter a delta's pre-delta rows back (exact rollback: restores
-        the bit pattern the rows had before apply_delta)."""
+        the bit pattern the rows had before apply_delta — in tiered mode
+        across every tier the delta touched)."""
         for name, cd in delta.coordinates.items():
             self._scatter_coordinate(name, cd.rows, cd.prior)
         self.delta_seq = delta.seq - 1
         self.deltas_reverted += 1
+
+    # -- tiered-store observability ----------------------------------------
+
+    def store_totals(self) -> Dict[str, int]:
+        """Cumulative tier counters summed over every store-backed
+        coordinate (the ServingMetrics probe; all zeros when fully
+        resident)."""
+        from photon_ml_tpu.store.entity import store_totals
+        return store_totals(self._stores)
+
+    def store_health(self) -> Optional[Dict]:
+        """Per-coordinate residency + the aggregate hot hit rate for
+        /healthz (None when fully resident)."""
+        if not self._stores:
+            return None
+        totals = self.store_totals()
+        lookups = (totals["hot_hits"] + totals["warm_hits"]
+                   + totals["cold_misses"])
+        return {
+            "hit_rate": (round(totals["hot_hits"] / lookups, 4)
+                         if lookups else None),
+            "promotions": totals["promotions"],
+            "spills": totals["spills"],
+            "coordinates": {name: st.residency()
+                            for name, st in self._stores.items()},
+        }
+
+    def flush_stores(self) -> int:
+        """Durably spill every dirty warm segment (shutdown/seal hook).
+        Returns segments written."""
+        return sum(st.flush() for st in self._stores.values())
 
     # -- request scoring ---------------------------------------------------
 
@@ -427,9 +609,26 @@ class CompiledScorer:
 
     def _lanes_for_chunk(self, ids, lo, hi):
         lanes, hits, lookups = {}, 0, 0
+        store_tables = {}
         for name, _shard, re_type in self._re_meta:
-            ln = _resolve_lanes(self._lookups[name],
-                                np.asarray(ids[re_type])[lo:hi])
+            col = np.asarray(ids[re_type])[lo:hi]
+            st = self._stores.get(name)
+            if st is not None:
+                # tiered mode: resolve ids -> global rows, then stage the
+                # chunk's misses into the per-batch staging window
+                # (promotion into the main table is amortized); lanes
+                # are SLOTS into the returned snapshot/window
+                rows = st.resolve(col)
+                slots, stage, table, staged_vals = st.lookup_slots(rows)
+                window = np.zeros((st.overlay_rows, st.dim),
+                                  np.dtype(st.dtype))
+                window[: len(staged_vals)] = staged_vals
+                lanes[name] = slots
+                lanes[name + "@stage"] = stage
+                store_tables[name] = (table, window)
+                hits += int((rows >= 0).sum()); lookups += len(rows)
+                continue
+            ln = _resolve_lanes(self._lookups[name], col)
             lanes[name] = ln
             hits += int((ln >= 0).sum()); lookups += len(ln)
         for name, row_t, col_t in self._mf_meta:
@@ -438,7 +637,7 @@ class CompiledScorer:
                                     np.asarray(ids[t])[lo:hi])
                 lanes[name + side] = ln
                 hits += int((ln >= 0).sum()); lookups += len(ln)
-        return lanes, hits, lookups
+        return lanes, hits, lookups, store_tables
 
     def score(self, features: Dict[str, np.ndarray],
               ids: Optional[Dict[str, np.ndarray]] = None,
@@ -461,13 +660,14 @@ class CompiledScorer:
                 x = np.asarray(features[shard])[lo:hi]
                 xs[shard] = (x if pad == 0 else
                              np.pad(x, ((0, pad), (0, 0))))
-            lanes, h, lk = self._lanes_for_chunk(ids, lo, hi)
+            lanes, h, lk, store_tables = self._lanes_for_chunk(ids, lo, hi)
             if pad:
                 lanes = {k: np.pad(v, (0, pad), constant_values=-1)
                          for k, v in lanes.items()}
             hits += h; lookups += lk
             buckets.append(bucket)
-            z = self._run_bucket(xs, lanes, bucket)
+            z = self._run_bucket(xs, lanes, bucket,
+                                 store_tables=store_tables)
             out[lo:hi] = np.asarray(z)[:m]
         return ScoreBatchResult(
             scores=out, num_rows=n, buckets=buckets,

@@ -41,6 +41,14 @@ class ServingConfig:
     latency_window: int = 8192      # latency ring for percentiles
     max_delta_log: int = 4096       # delta undo-log bound (overflow ->
                                     # rollback degrades to full-model)
+    # tiered entity store (photon_ml_tpu/store/): a non-None budget
+    # serves every RE table through a device hot set of store_budget_rows
+    # rows, a host warm tier, and sealed cold segments under store_dir
+    # (REQUIRED with a budget; each installed version gets a subdir)
+    store_budget_rows: Optional[int] = None
+    store_dir: Optional[str] = None
+    store_warm_segments: int = 64
+    store_seg_rows: int = 16384
 
 
 class ScoringService:
@@ -75,20 +83,47 @@ class ScoringService:
             self.health = HealthMonitor(health, metrics=self.metrics)
         cfg = self.config
 
+        store_cfg = None
+        if cfg.store_budget_rows is not None:
+            if cfg.store_dir is None:
+                raise ValueError("store_budget_rows requires store_dir "
+                                 "(the cold tier's segment directory)")
+            from photon_ml_tpu.store import StoreConfig
+            store_cfg = StoreConfig(hot_rows=cfg.store_budget_rows,
+                                    warm_segments=cfg.store_warm_segments,
+                                    seg_rows=cfg.store_seg_rows)
+
+        def _store_kw(version):
+            if store_cfg is None:
+                return {}
+            import os
+            import re as _re
+            sub = _re.sub(r"[^A-Za-z0-9._-]", "_", str(version))
+            return {"store": store_cfg,
+                    "store_dir": os.path.join(cfg.store_dir, sub)}
+
         def factory(version_dir, version):
             if version_dir is None:  # initial in-memory model
                 scorer = CompiledScorer(model, max_batch=cfg.max_batch,
                                         min_bucket=cfg.min_bucket,
-                                        version=version)
+                                        version=version,
+                                        **_store_kw(version))
                 scorer.warmup()
                 return scorer
             return CompiledScorer.from_model_dir(
                 version_dir, max_batch=cfg.max_batch,
-                min_bucket=cfg.min_bucket, version=version)
+                min_bucket=cfg.min_bucket, version=version,
+                **_store_kw(version))
 
         self.registry = ModelRegistry(factory, emitter=emitter,
                                       metrics=self.metrics,
                                       max_delta_log=cfg.max_delta_log)
+        if store_cfg is not None:
+            # both metric surfaces sync the store.* counters to the live
+            # scorer's cumulative tier totals at render (the same
+            # discipline as the online updater vitals)
+            self.metrics.set_store_probe(
+                lambda: self.registry.scorer.store_totals())
         if self.health is not None:
             # registered BEFORE the initial load so the first install
             # stamps the version and starts the drift baseline
@@ -236,6 +271,14 @@ class ScoringService:
             "updates_enabled": self.updater is not None,
             "health_enabled": self.health is not None,
         }
+        store = self.registry.scorer.store_health()
+        if store is not None:
+            # the tiered store's hit rate is first-class health: a
+            # collapsing hot tier shows up here before it shows up as
+            # latency
+            out["store"] = {"hit_rate": store["hit_rate"],
+                            "promotions": store["promotions"],
+                            "spills": store["spills"]}
         if self.updater is not None:
             probe = self.updater.probe()
             probe["pending_rows"] = self.updater.buffer.pending_rows
@@ -289,6 +332,12 @@ class ScoringService:
             if self.updater is not None:
                 self.updater.close()
             self._batcher.close()
+            try:
+                # seal the cold tier: after close the store directory
+                # alone reproduces every online-updated row
+                self.registry.scorer.flush_stores()
+            except RuntimeError:
+                pass  # no model ever loaded
 
     def __enter__(self):
         return self

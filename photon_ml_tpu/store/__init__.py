@@ -1,0 +1,34 @@
+"""Tiered entity store: ONE residency layer for training, mesh staging,
+and serving 10M+ entity models on a ~1M-entity device budget.
+
+Photon ML scaled random-effect models past executor memory by keeping
+per-entity coefficients in PalDB while GAME iterated; this package is
+that hierarchy rebuilt for the JAX stack (Snap ML's accelerator/host/disk
+data management, arXiv 1803.06333):
+
+  * `TieredEntityStore` (store/entity.py) — a row table spanning a
+    device-resident hot set (pre-jitted drop-mode scatter/gather, sampled
+    LFU), a host-pinned warm set (authoritative row values, write-back
+    dirty tracking), and durable sealed cold segments (store/cold.py).
+    Tenants: the serving scorer, online delta swaps, replication replay,
+    and audit/training readers.
+  * `ResidencyRegistry` / `BlockStore` (store/handles.py) — the keyed
+    hot-tier registry behind parallel/mesh_residency.py and the block
+    handles game/residency.py rotates training residency through.
+  * `with_retries` / `StoreStats` / `StoreError` (store/base.py) — the
+    shared transient/fatal retry discipline and tier accounting; fault
+    sites `store.fetch` / `store.promote` / `store.spill`.
+"""
+from photon_ml_tpu.store.base import (StoreError, StoreStats,  # noqa: F401
+                                      with_retries)
+from photon_ml_tpu.store.cold import ColdStore, ColdStoreError  # noqa: F401
+from photon_ml_tpu.store.entity import (StoreConfig,  # noqa: F401
+                                        TieredEntityStore, store_totals)
+from photon_ml_tpu.store.handles import (BlockHandle,  # noqa: F401
+                                         BlockStore, ResidencyRegistry)
+
+__all__ = [
+    "BlockHandle", "BlockStore", "ColdStore", "ColdStoreError",
+    "ResidencyRegistry", "StoreConfig", "StoreError", "StoreStats",
+    "TieredEntityStore", "store_totals", "with_retries",
+]

@@ -1,0 +1,137 @@
+"""Shared plumbing of the tiered entity store: errors, the retry
+discipline, and the byte/row accounting every tier reports through.
+
+The store spans three tiers (device hot set, host warm set, disk cold
+segments — see store/entity.py) and two tenant shapes (row tables for
+serving/online, opaque coordinate blocks for training/mesh staging — see
+store/handles.py).  Everything that crosses a tier boundary goes through
+`with_retries`: the SAME transient/fatal classification and jittered
+exponential backoff the streaming Prefetcher and the mesh residency layer
+use, so a flaky disk read or host->device transfer is absorbed bit-exact
+while a fatal error names the entity block it killed.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.utils import faults, locktrace
+
+# retry policy — mirrors data/streaming.py's Prefetcher and the mesh
+# residency layer: transient failures (faults.is_transient) retry with
+# jittered exponential backoff, fatal ones (and always KeyboardInterrupt/
+# SystemExit) propagate immediately.
+RETRY_MAX_ATTEMPTS = 3
+RETRY_BACKOFF_S = 0.05
+RETRY_BACKOFF_JITTER = 0.5
+
+
+class StoreError(RuntimeError):
+    """A tier operation failed after exhausting its retry budget (or hit
+    a fatal, non-retryable error).  The message names the entity block /
+    segment; the original failure rides as __cause__."""
+
+
+def with_retries(fn: Callable[[], object], *, site: str, what: str,
+                 on_retry: Optional[Callable[[], None]] = None,
+                 jitter: Optional[random.Random] = None,
+                 error_cls: type = StoreError,
+                 **ctx) -> object:
+    """Run `fn` under the chunk-staging retry/backoff discipline with the
+    fault-injection site `site` fired before each attempt.  MUST be called
+    with no store lock held: transient retries sleep.  `error_cls` lets a
+    tenant keep its own terminal exception type (MeshStagingError)."""
+    jitter = jitter if jitter is not None else random.Random(0)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            # every caller passes a literal, SITES-registered site name;
+            # this helper is the shared retry mechanism, not a new site
+            faults.fire(site, **ctx)  # photonlint: disable=PH004
+            return fn()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            if isinstance(e, error_cls):
+                raise  # a nested retry scope already named the block
+            if not faults.is_transient(e):
+                raise error_cls(
+                    f"{site} failed for {what} (fatal "
+                    f"{type(e).__name__}, not retryable)") from e
+            if attempt >= RETRY_MAX_ATTEMPTS:
+                raise error_cls(
+                    f"{site} failed for {what} after "
+                    f"{attempt} attempt(s)") from e
+            if on_retry is not None:
+                on_retry()
+            delay = (RETRY_BACKOFF_S * (2 ** (attempt - 1))
+                     * (1.0 + RETRY_BACKOFF_JITTER * jitter.random()))
+            time.sleep(delay)
+
+
+class StoreStats:
+    """Row/byte accounting for one store (or the process-global registry
+    mirror): the observable form of the tiering policy.  hot/warm/cold
+    counters are PER ROW LOOKUP — a row resolved from the device-resident
+    hot set, one promoted out of the host warm set, one that needed a
+    disk segment read; promotions/spills/evictions count tier movements.
+    Thread-safe: scoring threads, the online updater, and the training
+    loop all hit one store concurrently."""
+
+    FIELDS = ("hot_hits", "warm_hits", "cold_misses", "promotions",
+              "spills", "evictions", "fetches", "retries")
+
+    def __init__(self, mirror: bool = True):
+        self._lock = locktrace.tracked(threading.Lock(), "StoreStats._lock")
+        self._mirror = mirror
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def _note(self, field: str, n: int) -> None:
+        if not n:
+            return
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+        if self._mirror:
+            # registry mirror: telemetry.snapshot() carries the tier split
+            # without reaching into any store instance
+            telemetry.counter(f"store.{field}").inc(n)
+
+    def note_lookup(self, hot: int = 0, warm: int = 0, cold: int = 0) -> None:
+        self._note("hot_hits", hot)
+        self._note("warm_hits", warm)
+        self._note("cold_misses", cold)
+
+    def note_promotion(self, rows: int = 1) -> None:
+        self._note("promotions", rows)
+
+    def note_spill(self, n: int = 1) -> None:
+        self._note("spills", n)
+
+    def note_eviction(self, n: int = 1) -> None:
+        self._note("evictions", n)
+
+    def note_fetch(self, n: int = 1) -> None:
+        self._note("fetches", n)
+
+    def note_retry(self) -> None:
+        self._note("retries", 1)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {f: getattr(self, f) for f in self.FIELDS}
+
+    def hit_rate(self) -> Optional[float]:
+        """Fraction of row lookups served from the hot tier (None before
+        any lookup)."""
+        with self._lock:
+            total = self.hot_hits + self.warm_hits + self.cold_misses
+            return (self.hot_hits / total) if total else None
+
+    @staticmethod
+    def delta(before: Dict[str, int], after: Dict[str, int]) -> Dict[str, int]:
+        return {k: after[k] - before.get(k, 0) for k in after}

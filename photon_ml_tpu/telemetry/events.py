@@ -39,6 +39,9 @@ EVENTS: Dict[str, str] = {
     "replog.append": "fault",
     "replog.read": "fault",
     "replica.apply": "fault",
+    "store.fetch": "fault",
+    "store.promote": "fault",
+    "store.spill": "fault",
     # -- flight-recorder triggers (telemetry.flight.TRIGGERS ->
     #    the `flight_dump` instant event) --------------------------------
     "health.gate_trip": "flight_dump",

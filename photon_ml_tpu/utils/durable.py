@@ -77,6 +77,21 @@ def atomic_write_json(path: str, obj, indent: int = 2, fsync: bool = True,
                       fsync=fsync, before_replace=before_replace)
 
 
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """Binary twin of atomic_write_text (the tiered store's cold-segment
+    spill path): tmp + fsync + atomic replace, so a crash mid-spill leaves
+    either the old complete segment or the new complete segment."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        fsync_dir(os.path.dirname(path) or ".")
+
+
 def append_text(path: str, text: str, fsync: bool = True) -> None:
     """Durable append for record logs (the replication log's segment
     files).  Appends are not atomic the way replace is: a crash mid-append

@@ -84,6 +84,22 @@ Injection sites currently threaded (ctx keys in parentheses):
                     transient faults retry with backoff and the replica
                     converges to the bit-identical table state, fatal
                     ones mark the replica failed
+  store.fetch       tiered-store tier fetch         (tier, block)
+                    (store/entity.py cold-segment reads + warm row reads,
+                    store/handles.py block re-stages); transient faults
+                    retry with the chunk-staging backoff discipline and
+                    are absorbed bit-exact, fatal ones raise StoreError
+                    naming the entity block/segment
+  store.promote     rows promoted into the device   (coordinate, rows)
+                    hot tier (store/entity.py); transient faults retry
+                    (the promote commit is idempotent), fatal ones name
+                    the entity block
+  store.spill       dirty warm segment written back (block)
+                    to the durable cold tier (store/entity.py); transient
+                    faults retry, fatal ones raise StoreError naming the
+                    entity block (the segment stays in the write-back
+                    buffer, so no row value is ever lost to a failed
+                    spill)
 """
 from __future__ import annotations
 
@@ -121,6 +137,9 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "replog.append": ("kind",),
     "replog.read": ("segment",),
     "replica.apply": ("kind",),
+    "store.fetch": ("tier", "block"),
+    "store.promote": ("coordinate", "rows"),
+    "store.spill": ("block",),
 }
 
 

@@ -462,6 +462,50 @@ def test_fleetobs_smoke(tmp_path):
     assert overhead["p99_ratio_armed_vs_disarmed"] > 0
 
 
+def test_store_smoke(tmp_path):
+    """bench.py --store --smoke end-to-end in tier-1 (ISSUE 14
+    satellite): the tiered-entity-store harness — budgeted-vs-all-
+    resident serving through the store, hot+warm delta swaps with
+    bit-exact rollback, the budgeted training parity gate, and the
+    zero-fresh-traces regression — cannot rot without failing the
+    normal test run.  The p99 latency half of the serving gate is a
+    smoke signal here (shared CPUs); it is HARD on the committed full
+    bench run."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_store.json"
+    result = bench.store_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    by_name = {e["name"]: e for e in detail["entries"]}
+    serving = by_name["store_serving"]
+    # the residency claim: far more entities than device-resident rows,
+    # served at >= 90% hot hit rate
+    assert serving["hot_rows"] < serving["entities"]
+    assert serving["hit_rate_ok"] is True
+    assert serving["budgeted"]["hit_rate"] >= 0.90
+    # promotions flush BETWEEN measurement windows (the off-peak pacing
+    # the bench documents), so assert on the store's cumulative counter
+    assert serving["budgeted"]["residency"]["promotions"] > 0
+    delta = by_name["store_delta"]
+    assert delta["rollback_bit_exact"] is True
+    assert delta["durable_round_trip_exact"] is True
+    assert delta["delta_rows_hot_tier"] > 0
+    assert delta["delta_rows_warm_tier"] > 0
+    training = by_name["store_training"]
+    assert training["objective_history_max_rel_gap"] <= 1e-10
+    assert training["evictions"] > 0 and training["store_fetches"] > 0
+    traces = by_name["store_traces"]
+    assert traces["serving_fresh_traces"] == 0
+    assert traces["training_fresh_traces"] == 0
+    assert traces["serving_exercised"] is True
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a

@@ -509,12 +509,16 @@ def test_two_subprocess_replicas_front_merge(tmp_path):
             "per_user": r.normal(size=(n, D_U)).tolist()},
             "ids": {"userId": [f"u{i}" for i in range(n)]},
             "labels": [0.0, 1.0] * (n // 2)}
+        applied0 = _http(f0_url, "/metrics.json")[1]["fleet"][
+            "records_applied"]
         status, _ = _http(front_url, "/feedback", body,
                           headers={TRACE_HEADER: fb_rid})
         assert status == 202
-        # the delta must land on the follower before we drain
+        # the feedback's DELTA must land on the follower before we drain
+        # (>= applied0 + 1: the bootstrap swap record already counts
+        # toward records_applied, so an absolute >= 1 races the drain)
         assert _wait(lambda: _http(f0_url, "/metrics.json")[1]
-                     ["fleet"]["records_applied"] >= 1)
+                     ["fleet"]["records_applied"] >= applied0 + 1)
     finally:
         for proc in (front, pub, f0):
             if proc is not None:

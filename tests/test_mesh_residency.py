@@ -259,9 +259,15 @@ def test_default_residency_singleton_under_thread_race():
         mr._DEFAULT = prev
 
 
-def test_clear_mesh_block_cache_alias_still_flushes():
-    from photon_ml_tpu.parallel.random_effect import clear_mesh_block_cache
-    clear_mesh_block_cache()
+def test_clear_mesh_block_cache_alias_retired():
+    """ISSUE 14 satellite: the deprecated global-flush alias is GONE —
+    invalidation routes through the tiered store's residency registry
+    (per-coordinate `invalidate`, or `clear()` on the registry itself)."""
+    import photon_ml_tpu.parallel.random_effect as re_mod
+    assert not hasattr(re_mod, "clear_mesh_block_cache")
+    # the registry's own clear() remains the sanctioned full flush
+    from photon_ml_tpu.parallel import mesh_residency
+    mesh_residency.clear()
     assert default_residency().num_entries() == 0
 
 
