@@ -732,6 +732,14 @@ def _run(args, log) -> int:
             log.info("solver %-16s solves=%d iterations=%d reasons=%s "
                      "caps=%s", coord, d["solves"], d["iterations"],
                      d["reasons"], d["iteration_caps"])
+            if "stream" in d:
+                st = d["stream"]
+                log.info("stream %-16s staged=%.1f MB chunks=%d "
+                         "local_epochs=%d examples=%d "
+                         "examples/staged-byte=%.4f", coord,
+                         st["total_bytes"] / 1e6, st["chunks_staged"],
+                         st["local_epochs"], st["examples_processed"],
+                         st["examples_per_staged_byte"])
         if mesh is not None and summary["mesh_transfer"] is not None:
             acct = summary["hbm_residency"] or {}
             log.info(

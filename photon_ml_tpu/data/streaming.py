@@ -189,6 +189,14 @@ class StreamStats:
         # and chunks that exhausted the retry budget (gave_up)
         self.retries = 0
         self.gave_up = 0
+        # work-per-staged-byte accounting: chunk-epochs executed on
+        # resident chunks (1 per chunk for a plain oracle pass, K per
+        # chunk when the stochastic lane pins the chunk for K local
+        # epochs) and examples processed (real rows x epochs).  The ratio
+        # examples_processed / total_bytes is THE out-of-core efficiency
+        # number — bench --stoch gates its improvement.
+        self.local_epochs = 0
+        self.examples_processed = 0
 
     def note_retry(self) -> None:
         with self._lock:
@@ -224,15 +232,34 @@ class StreamStats:
         with self._lock:
             self.passes += 1
 
+    def note_processed(self, rows: int, epochs: int = 1) -> None:
+        """`epochs` chunk-epochs of consumer work on one resident chunk
+        covering `rows` real (unpadded) rows."""
+        with self._lock:
+            self.local_epochs += epochs
+            self.examples_processed += rows * epochs
+        telemetry.counter("stream.local_epochs").inc(epochs)
+        telemetry.counter("stream.examples").inc(rows * epochs)
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return {"total_bytes": self.total_bytes,
+            snap = {"total_bytes": self.total_bytes,
                     "chunks_staged": self.chunks_staged,
                     "passes": self.passes,
                     "peak_resident_chunks": self.peak_resident_chunks,
                     "peak_resident_bytes": self.peak_resident_bytes,
                     "retries": self.retries,
-                    "gave_up": self.gave_up}
+                    "gave_up": self.gave_up,
+                    "local_epochs": self.local_epochs,
+                    "examples_processed": self.examples_processed}
+        snap["examples_per_staged_byte"] = (
+            snap["examples_processed"] / snap["total_bytes"]
+            if snap["total_bytes"] else 0.0)
+        # metrics mirror: the ratio as a gauge so operators see
+        # work-per-staged-byte without dividing counters themselves
+        telemetry.gauge("stream.examples_per_staged_byte").set(
+            snap["examples_per_staged_byte"])
+        return snap
 
 
 def _tree_device_put(host_tree):
@@ -331,7 +358,22 @@ class Prefetcher:
                          * (1.0 + STAGE_BACKOFF_JITTER * jitter.random()))
                 time.sleep(delay)
 
-    def stream(self) -> Iterator[Tuple[ChunkSpec, object]]:
+    def stream(self, pin_epochs: int = 1
+               ) -> Iterator[Tuple[ChunkSpec, object]]:
+        """One full pass over the plan's chunks.
+
+        `pin_epochs` declares how many local epochs the CONSUMER will run
+        on each yielded chunk before asking for the next one (the
+        stochastic lane, optim/stochastic.py).  The chunk is staged ONCE
+        and stays pinned on device for all of them — it never round-trips
+        back through the queue — while the producer keeps prefetching the
+        next chunk behind it (the double-buffer bound is unchanged: at
+        most `depth` chunks resident).  StreamStats accounts the extra
+        work: `local_epochs` += pin_epochs and `examples_processed` +=
+        rows * pin_epochs per chunk, which is what moves
+        examples_per_staged_byte."""
+        if pin_epochs < 1:
+            raise ValueError(f"pin_epochs must be >= 1, got {pin_epochs}")
         self.stats.note_pass()
         lookahead = threading.Semaphore(self.depth - 1)
         q: "queue.Queue" = queue.Queue()
@@ -396,6 +438,7 @@ class Prefetcher:
                     self.stats.note_released(prev_bytes)
                 prev_bytes = _tree_nbytes(dev)
                 lookahead.release()
+                self.stats.note_processed(spec.rows, pin_epochs)
                 yield spec, dev
                 dev = None
         finally:

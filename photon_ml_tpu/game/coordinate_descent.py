@@ -126,6 +126,12 @@ class TrackerSummary:
     # show 0 everywhere.  None when the tracer is disarmed (the counter
     # only advances while the compile watch is armed).
     retraces: Optional[int] = None
+    # chunk-stream accounting delta for THIS visit (streamed FE
+    # coordinates only, StreamStats snapshot diff): staged bytes/chunks,
+    # local epochs, examples processed, and the derived
+    # examples_per_staged_byte — the stochastic lane's win is this ratio
+    # going up by ~the local epoch count.  None on resident coordinates.
+    stream: Optional[Dict[str, object]] = None
 
 
 def _reason_counts(reason) -> Dict[str, int]:
@@ -222,6 +228,12 @@ class CoordinateDescentResult:
                                   {"cold": 0, "warm": 0})
                 sb["cold"] += t.staged_bytes.get("cold", 0)
                 sb["warm"] += t.staged_bytes.get("warm", 0)
+            if t.stream is not None:
+                st = d.setdefault("stream", {
+                    "passes": 0, "chunks_staged": 0, "total_bytes": 0,
+                    "local_epochs": 0, "examples_processed": 0})
+                for k in st:
+                    st[k] += t.stream.get(k, 0)
         # host-blocked attribution: span labels are "{it}/{coord}/{phase}"
         blocked = getattr(self.timings, "host_blocked", None) or {}
         for label, seconds in blocked.items():
@@ -230,6 +242,11 @@ class CoordinateDescentResult:
                 out[parts[1]]["host_blocked_s"] += seconds
         for d in out.values():
             d["host_blocked_s"] = round(d["host_blocked_s"], 4)
+            if "stream" in d:
+                st = d["stream"]
+                st["examples_per_staged_byte"] = (
+                    st["examples_processed"] / st["total_bytes"]
+                    if st["total_bytes"] else 0.0)
         return out
 
 
@@ -823,6 +840,24 @@ def run_coordinate_descent(
         after = _mesh_snap()
         return {"cold": after["cold_bytes"] - before["cold_bytes"],
                 "warm": after["warm_bytes"] - before["warm_bytes"]}
+
+    def _stream_delta(coord, before):
+        """Per-visit StreamStats delta for a streamed coordinate (None
+        otherwise): the chunk-stream work/bytes THIS visit moved, plus
+        the derived examples_per_staged_byte ratio."""
+        snap_fn = getattr(coord, "stream_snapshot", None)
+        after = snap_fn() if callable(snap_fn) else None
+        if after is None:
+            return None
+        before = before or {}
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in ("passes", "chunks_staged", "total_bytes",
+                           "local_epochs", "examples_processed",
+                           "retries")}
+        delta["examples_per_staged_byte"] = (
+            delta["examples_processed"] / delta["total_bytes"]
+            if delta["total_bytes"] else 0.0)
+        return delta
     spans = PhaseTimings() if timings is None else timings
     with spans.span("init/transfer"):
         labels = jnp.asarray(dataset.response)
@@ -1054,6 +1089,7 @@ def run_coordinate_descent(
             trackers[key].containment = ("rolled_back" if not healthy
                                          else p["containment"])
             trackers[key].staged_bytes = p["staged"]
+            trackers[key].stream = p["stream"]
             trackers[key].retraces = p["retraces"]
             logger.info("iter %d coordinate %-16s objective=%.8g (%.2fs)",
                         p["it"], p["name"], obj, spans[p["solve_key"]])
@@ -1110,6 +1146,8 @@ def run_coordinate_descent(
                 frozen = monitor.is_frozen(name)
                 prev_model = models[name]
                 mesh_before = _mesh_snap() if _mesh_snap else None
+                stream_before = getattr(coord, "stream_snapshot",
+                                        lambda: None)()
                 sched = (solver_schedules or {}).get(name)
                 budget_diag = None
                 tracker = None
@@ -1244,6 +1282,7 @@ def run_coordinate_descent(
                     # consumers finish.
                     residency.after_update(name)
                 staged = _staged_delta(mesh_before)
+                stream_d = _stream_delta(coord, stream_before)
                 # fresh traces during this visit (tracing happens at
                 # dispatch time, so the count is settled HERE even in
                 # pipelined mode — nothing below launches device work)
@@ -1253,6 +1292,7 @@ def run_coordinate_descent(
                     if staged is not None:
                         trackers[f"{it}/{name}"].staged_bytes = staged
                     trackers[f"{it}/{name}"].retraces = retraces
+                    trackers[f"{it}/{name}"].stream = stream_d
                 if pipelined:
                     pending.append({"it": it, "name": name,
                                     "solve_key": solve_key,
@@ -1263,6 +1303,7 @@ def run_coordinate_descent(
                                     "health": health_dev,
                                     "prev_model": prev_model,
                                     "staged": staged,
+                                    "stream": stream_d,
                                     "retraces": retraces,
                                     "containment": ("frozen" if frozen
                                                     else None)})

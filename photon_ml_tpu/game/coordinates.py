@@ -276,6 +276,15 @@ class FixedEffectCoordinate:
         row_bytes = (self.dim + 4) * self._canonical.itemsize
         return 2 * plan.chunk_bytes(row_bytes)
 
+    def stream_snapshot(self) -> Optional[dict]:
+        """StreamStats snapshot of the coordinate's chunk stream (None
+        when resident): the per-visit deltas land in TrackerSummary.stream
+        and solver_diagnostics() so work-per-staged-byte is observable
+        per fit."""
+        if not self.streamed:
+            return None
+        return self._stream.stats.snapshot()
+
     def evict_device_blocks(self) -> None:
         """Residency-manager hook: drop the device shard between visits
         (no-op when streamed — nothing is pinned).  The mesh path drops
@@ -322,10 +331,20 @@ class FixedEffectCoordinate:
             x0 = model.glm.coefficients.means
             if self.norm is not None:
                 x0 = self.norm.model_to_transformed_space(x0)
+            # coarse-early / polish-late lane selection: a schedule with a
+            # stochastic lane runs early outer iterations as per-chunk
+            # local epochs (one staging pass does local_epochs passes of
+            # work) and leaves the trailing iterations on the strict
+            # host-stepped solver (only SolverSchedule carries the lane;
+            # the quarantine retry schedule duck-type does not)
+            stoch = None
+            stoch_plan = getattr(schedule, "stochastic_plan", None)
+            if callable(stoch_plan):
+                stoch = stoch_plan(outer_iteration, num_outer_iterations)
             res = solve_streamed(obj, x0, opt.optimizer, opt.regularization,
                                  jnp.asarray(opt.regularization_weight,
                                              self._canonical),
-                                 budget=budget)
+                                 budget=budget, stochastic=stoch)
             c = res.x
             if self.norm is not None:
                 c = self.norm.model_to_original_space(c)

@@ -12,6 +12,13 @@ describes (arXiv:1803.06333):
      update and are ~d times smaller than any feature block.
   2. A fixed-effect shard whose resident footprint busts the budget runs
      STREAMED (ChunkedGLMObjective: host shard, two chunks of HBM).
+     Streaming's per-iteration staging cost is what the stochastic lane
+     (optim/stochastic.py) amortizes: with a SolverSchedule whose
+     stochastic_passes > 0, each staged chunk does a full epoch's worth
+     of local solver work before eviction, so the auto-stream decision's
+     downside shrinks by the local epoch count — the per-coordinate
+     `stream` snapshots in `accounting()` (examples_per_staged_byte)
+     make that trade observable per fit.
   3. When the remaining resident coordinates still exceed the budget, the
      descent loop rotates residency: after a coordinate's update+score its
      device blocks are EVICTED and re-streamed on its next visit (host
@@ -86,7 +93,14 @@ class ResidencyManager:
         per_dev = lambda b: int(math.ceil(b / self.data_devices))
         self.footprints: Dict[str, CoordinateFootprint] = {}
         self.store = BlockStore()
+        # streamed coordinates' chunk-stream accounting, surfaced through
+        # accounting() so bench --stream/--stoch and the cli summary see
+        # work-per-staged-byte next to the byte peaks
+        self._stream_snapshots = {}
         for name, coord in coordinates.items():
+            snap_fn = getattr(coord, "stream_snapshot", None)
+            if getattr(coord, "streamed", False) and callable(snap_fn):
+                self._stream_snapshots[name] = snap_fn
             streamed = bool(getattr(coord, "streamed", False))
             block_bytes = (0 if streamed
                            else per_dev(int(coord.device_block_bytes())))
@@ -175,4 +189,6 @@ class ResidencyManager:
             "under_budget": (self.budget_bytes is None
                              or self.peak_tracked_bytes <= self.budget_bytes),
             "store": self.store.snapshot(),
+            "stream": {name: fn()
+                       for name, fn in self._stream_snapshots.items()},
         }

@@ -33,13 +33,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.streaming import (
-    ChunkPlan, ChunkSpec, Prefetcher, StreamStats, pad_rows_host,
+    STAGE_BACKOFF_JITTER, STAGE_BACKOFF_S, STAGE_MAX_ATTEMPTS, ChunkPlan,
+    ChunkSpec, Prefetcher, StreamStats, pad_rows_host,
 )
 from photon_ml_tpu.ops import aggregators as agg
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext
 
 _SAFE_LABEL = 0.5  # valid for every loss family (see pad_batch_to_mesh)
+
+
+class LocalSolveError(RuntimeError):
+    """A chunk's stochastic local solve failed after exhausting its retry
+    budget (or hit a fatal, non-retryable error).  The message names the
+    chunk; the original failure rides as __cause__."""
+
+    def __init__(self, message: str, chunk_index: int):
+        super().__init__(message)
+        self.chunk_index = chunk_index
 
 
 # -- per-chunk accumulation kernels: one trace per chunk SHAPE ---------------
@@ -242,6 +253,101 @@ class ChunkedGLMObjective:
                 out = np.empty(self.plan.num_rows, z.dtype)
             out[spec.start:spec.stop] = z[:spec.rows]
         return jnp.asarray(out)
+
+    # -- stochastic local-solver lane (optim/stochastic.py) -------------------
+    def stochastic_pass(self, c: jax.Array, *, local_epochs: int,
+                        seed: int = 0, pass_index: int = 0,
+                        merge: str = "sequential",
+                        step_clip: Optional[float] = None):
+        """ONE stochastic pass over the chunk stream: each chunk is staged
+        ONCE (the Prefetcher pins it — no queue round-trip) and runs
+        `local_epochs` epochs of seeded coordinate descent as one device
+        program, so the pass does local_epochs gradient-passes of work
+        for a single pass of staging bandwidth.
+
+        Per-chunk models merge hierarchically: within a chunk the mesh's
+        data axis merges via the psums GSPMD inserts into the kernel's
+        dot products; across the stream `merge` picks sequential
+        warm-starting (default) or the row-weighted delta average.  The
+        chunk's share of the L2 term is rows/num_rows — a full pass
+        applies the configured l2_weight exactly once in aggregate.
+
+        Returns (updated coefficients, entry objective) — the entry
+        objective is the summed chunk-entry data loss plus the L2 term at
+        the pass-entry model, a DEVICE scalar (the driver reads it back
+        once per pass).
+
+        Containment: the `solve.local` fault site fires once per (chunk,
+        pass); transient failures retry the chunk's local epochs (the
+        kernel is deterministic, so a retry is bit-exact), fatal ones
+        raise LocalSolveError naming the chunk.
+        """
+        import random as _random
+        import time as _time
+
+        from photon_ml_tpu import telemetry
+        from photon_ml_tpu.optim.stochastic import (
+            _local_epochs, resolve_step_clip,
+        )
+        from photon_ml_tpu.utils import faults
+
+        c = jnp.asarray(c)
+        dtype = c.dtype
+        l2 = jnp.asarray(self.l2_weight, dtype)
+        clip = jnp.asarray(resolve_step_clip(self.loss, step_clip), dtype)
+        key0 = jax.random.fold_in(jax.random.PRNGKey(seed), pass_index)
+        n = self.plan.num_rows
+        c_start = c
+        entry_acc = jnp.zeros((), dtype)
+        acc_dw = jnp.zeros_like(c) if merge == "average" else None
+        jitter = _random.Random(pass_index)
+        for spec, ch in self._prefetcher.stream(pin_epochs=local_epochs):
+            key = jax.random.fold_in(key0, spec.index)
+            l2_local = l2 * (spec.rows / n)
+            c_in = c_start if merge == "average" else c
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    faults.fire("solve.local", chunk=spec.index,
+                                epoch=pass_index)
+                    c_out, entry = _local_epochs(
+                        c_in, ch["x"], ch["labels"], ch["weights"],
+                        ch["offsets"], ch["mask"], self.norm, key,
+                        l2_local, clip, loss=self.loss,
+                        epochs=local_epochs)
+                    break
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:
+                    if not faults.is_transient(e):
+                        raise LocalSolveError(
+                            f"stochastic local solve failed for chunk "
+                            f"{spec.index} of {self.plan.num_chunks} "
+                            f"(fatal {type(e).__name__}, not retryable)",
+                            spec.index) from e
+                    if attempt >= STAGE_MAX_ATTEMPTS:
+                        raise LocalSolveError(
+                            f"stochastic local solve failed for chunk "
+                            f"{spec.index} of {self.plan.num_chunks} "
+                            f"after {attempt} attempt(s)",
+                            spec.index) from e
+                    telemetry.counter("stream.local_solve_retries").inc()
+                    telemetry.event("local_solve_retry", chunk=spec.index,
+                                    attempt=attempt,
+                                    error=f"{type(e).__name__}: {e}")
+                    delay = (STAGE_BACKOFF_S * (2 ** (attempt - 1))
+                             * (1.0 + STAGE_BACKOFF_JITTER
+                                * jitter.random()))
+                    _time.sleep(delay)
+            entry_acc = entry_acc + entry
+            if merge == "average":
+                acc_dw = acc_dw + (spec.rows / n) * (c_out - c_start)
+            else:
+                c = c_out
+        if merge == "average":
+            c = c_start + acc_dw
+        return c, _add_l2_value(entry_acc, c_start, l2)
 
     # -- helpers --------------------------------------------------------------
     def replace(self, **kw) -> "ChunkedGLMObjective":

@@ -45,6 +45,13 @@ class PointwiseLoss:
     d2z: Callable[[jax.Array, jax.Array], jax.Array]
     mean: Callable[[jax.Array], jax.Array]
     twice_differentiable: bool = True
+    # global upper bound on d2z over all (z, y), when one exists: the
+    # majorization constant the stochastic coordinate lane
+    # (optim/stochastic.py) uses for closed-form per-coordinate steps —
+    # a step against bound*||x_j||^2 curvature can never overshoot the
+    # 1-D subproblem.  None (Poisson: d2z = e^z is unbounded) means the
+    # lane falls back to current-point curvature with a step clip.
+    d2z_bound: "float | None" = 1.0
 
     def loss_and_dz(self, z: jax.Array, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
         return self.loss(z, y), self.dz(z, y)
@@ -83,6 +90,7 @@ LOGISTIC = PointwiseLoss(
     dz=_logistic_dz,
     d2z=_logistic_d2z,
     mean=jax.nn.sigmoid,
+    d2z_bound=0.25,  # s(1-s) <= 1/4
 )
 
 
@@ -105,6 +113,7 @@ POISSON = PointwiseLoss(
     dz=lambda z, y: jnp.exp(z) - y,
     d2z=lambda z, y: jnp.exp(z),
     mean=jnp.exp,
+    d2z_bound=None,  # e^z is unbounded
 )
 
 

@@ -30,10 +30,45 @@ uninterrupted trajectory bit-for-bit.
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class StochasticPlan:
+    """One outer iteration's budget for the stochastic streaming lane
+    (optim/stochastic.py): how many full passes over the chunk stream to
+    run, how many local coordinate-descent epochs each staged chunk gets
+    before eviction, and how per-chunk models merge across the stream.
+
+    `merge`:
+      - "sequential" (default): chunk k's local solve warm-starts from
+        chunk k-1's result — the model flows through the stream (the
+        best-converging order when chunks are visited one at a time);
+      - "average": every chunk starts from the pass-entry model and the
+        per-chunk deltas combine as a row-weighted average (the
+        CoCoA/Snap-ML safe merge — the order-independent mode).
+
+    `step_clip` bounds each per-coordinate step for losses WITHOUT a
+    global curvature bound (Poisson); None resolves to no clip for
+    bounded-curvature losses and 1.0 for unbounded ones."""
+
+    passes: int = 1
+    local_epochs: int = 4
+    merge: str = "sequential"
+    seed: int = 0
+    step_clip: Optional[float] = None
+
+    def __post_init__(self):
+        if self.passes < 0:
+            raise ValueError("passes must be >= 0")
+        if self.local_epochs < 1:
+            raise ValueError("local_epochs must be >= 1")
+        if self.merge not in ("sequential", "average"):
+            raise ValueError(f"merge must be 'sequential' or 'average', "
+                             f"got {self.merge!r}")
 
 
 class SolveBudget(NamedTuple):
@@ -63,12 +98,30 @@ class SolverSchedule:
 
     Applied uniformly to fixed-effect, random-effect, and factored-MF
     coordinates (both the latent-space and projection-matrix solves).
+
+    The STOCHASTIC lane (optim/stochastic.py) layers on top for STREAMED
+    fixed-effect coordinates: with `stochastic_passes > 0`, every outer
+    iteration except the final `stochastic_polish_iterations` runs the
+    coarse per-chunk coordinate-descent lane (each staged chunk does
+    `stochastic_local_epochs` epochs of local work before eviction, so
+    useful work per staged byte goes up by the epoch count) and the
+    trailing iterations run the strict host-stepped solver at this
+    schedule's budgets — the polish that pins the fixed point.  Resident
+    coordinates ignore the stochastic fields (their data never re-stages,
+    so there is nothing to amortize).
     """
 
     initial_iterations: int = 4
     iteration_growth: float = 2.0
     initial_tolerance_factor: float = 1e3
     tolerance_decay: float = 0.1
+    # stochastic streaming lane (0 passes = disabled, the pre-existing
+    # strict-only behavior)
+    stochastic_passes: int = 0
+    stochastic_local_epochs: int = 4
+    stochastic_merge: str = "sequential"
+    stochastic_seed: int = 0
+    stochastic_polish_iterations: int = 1
 
     def __post_init__(self):
         if self.initial_iterations < 1:
@@ -80,6 +133,18 @@ class SolverSchedule:
             raise ValueError("initial_tolerance_factor must be >= 1")
         if not 0.0 < self.tolerance_decay <= 1.0:
             raise ValueError("tolerance_decay must be in (0, 1]")
+        if self.stochastic_passes < 0:
+            raise ValueError("stochastic_passes must be >= 0")
+        if self.stochastic_local_epochs < 1:
+            raise ValueError("stochastic_local_epochs must be >= 1")
+        if self.stochastic_merge not in ("sequential", "average"):
+            raise ValueError("stochastic_merge must be 'sequential' or "
+                             f"'average', got {self.stochastic_merge!r}")
+        if self.stochastic_polish_iterations < 1:
+            raise ValueError("stochastic_polish_iterations must be >= 1 "
+                             "(the final outer iterations ALWAYS polish "
+                             "with the strict solver — parity at the fixed "
+                             "point depends on it)")
 
     def plan(self, outer_iteration: int, num_outer_iterations: int,
              max_iterations: int, tolerance: float) -> Tuple[int, float]:
@@ -103,12 +168,43 @@ class SolverSchedule:
                              r.max_iterations, r.tolerance)
         return SolveBudget.make(cap, tol)
 
+    def stochastic_plan(self, outer_iteration: int,
+                        num_outer_iterations: int
+                        ) -> Optional[StochasticPlan]:
+        """The stochastic lane's budget for one outer iteration, or None
+        when the strict host-stepped solver should run: lane disabled, or
+        this is one of the final `stochastic_polish_iterations` outer
+        iterations (the polish ALWAYS runs strict, so a fit's final visit
+        converges to the same fixed point a strict-only fit would)."""
+        if self.stochastic_passes <= 0:
+            return None
+        polish_from = num_outer_iterations - self.stochastic_polish_iterations
+        if outer_iteration >= polish_from:
+            return None
+        return StochasticPlan(passes=self.stochastic_passes,
+                              local_epochs=self.stochastic_local_epochs,
+                              merge=self.stochastic_merge,
+                              seed=self.stochastic_seed)
+
     # -- JSON round-trip (game/config.py embeds schedules in model metadata)
     def to_dict(self) -> dict:
-        return {"initial_iterations": self.initial_iterations,
-                "iteration_growth": self.iteration_growth,
-                "initial_tolerance_factor": self.initial_tolerance_factor,
-                "tolerance_decay": self.tolerance_decay}
+        d = {"initial_iterations": self.initial_iterations,
+             "iteration_growth": self.iteration_growth,
+             "initial_tolerance_factor": self.initial_tolerance_factor,
+             "tolerance_decay": self.tolerance_decay}
+        # stochastic keys encode only when the lane is enabled, so
+        # pre-existing checkpoint fingerprints of strict-only schedules
+        # stay byte-identical
+        if self.stochastic_passes > 0:
+            d.update({
+                "stochastic_passes": self.stochastic_passes,
+                "stochastic_local_epochs": self.stochastic_local_epochs,
+                "stochastic_merge": self.stochastic_merge,
+                "stochastic_seed": self.stochastic_seed,
+                "stochastic_polish_iterations":
+                    self.stochastic_polish_iterations,
+            })
+        return d
 
     @staticmethod
     def from_dict(d) -> "SolverSchedule | None":
@@ -118,7 +214,13 @@ class SolverSchedule:
             initial_iterations=d.get("initial_iterations", 4),
             iteration_growth=d.get("iteration_growth", 2.0),
             initial_tolerance_factor=d.get("initial_tolerance_factor", 1e3),
-            tolerance_decay=d.get("tolerance_decay", 0.1))
+            tolerance_decay=d.get("tolerance_decay", 0.1),
+            stochastic_passes=d.get("stochastic_passes", 0),
+            stochastic_local_epochs=d.get("stochastic_local_epochs", 4),
+            stochastic_merge=d.get("stochastic_merge", "sequential"),
+            stochastic_seed=d.get("stochastic_seed", 0),
+            stochastic_polish_iterations=d.get(
+                "stochastic_polish_iterations", 1))
 
 
 @dataclasses.dataclass(frozen=True)

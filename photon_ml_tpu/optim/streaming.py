@@ -378,6 +378,7 @@ def solve_streamed(
     reg: RegularizationContext = RegularizationContext(),
     reg_weight: jax.Array | float = 0.0,
     budget=None,
+    stochastic=None,
 ) -> SolveResult:
     """solve() for a ChunkedGLMObjective: same dispatch rules as
     optim.config.solve (L2 into the smooth objective, L1 to OWLQN, TRON
@@ -386,7 +387,17 @@ def solve_streamed(
     `budget` (optim.schedule.SolveBudget) overrides the iteration cap and
     tolerance for this solve — the host-stepped loop branches on host
     scalars, so a budget schedule never compiles anything new here by
-    construction."""
+    construction.
+
+    `stochastic` (optim.schedule.StochasticPlan) routes the solve to the
+    COARSE lane instead: `passes` stochastic passes over the chunk
+    stream, each staged chunk pinned for `local_epochs` of seeded
+    coordinate descent (optim/stochastic.py) — the per-staged-byte-cheap
+    mode SolverSchedule uses on early outer iterations, with these strict
+    host-stepped solvers as the final polish.  The lane handles smooth
+    L2-regularized objectives; L1 (OWLQN) and box-constrained solves fall
+    through to the strict lane (their prox/projection structure is the
+    host-stepped solver's job)."""
     cfg = config.resolved()
     if cfg.constraints is not None:
         raise ValueError(
@@ -394,6 +405,13 @@ def solve_streamed(
             "config.resolved_constraints(index_map) before solve_streamed()")
     l1_w, l2_w = reg.split(reg_weight)
     obj = objective.with_l2(l2_w)
+
+    if stochastic is not None and stochastic.passes > 0 \
+            and not reg.has_l1 \
+            and cfg.box_lower is None and cfg.box_upper is None:
+        from photon_ml_tpu.optim.stochastic import solve_stochastic
+        return solve_stochastic(obj, x0, stochastic,
+                                max_iterations=cfg.max_iterations)
     iteration_cap = None if budget is None else int(budget.iteration_cap)
     tolerance = cfg.tolerance if budget is None else float(budget.tolerance)
 

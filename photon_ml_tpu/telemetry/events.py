@@ -33,6 +33,7 @@ EVENTS: Dict[str, str] = {
     "model.save": "fault",
     "model.load": "fault",
     "solve.poison": "fault",
+    "solve.local": "fault",
     "online.solve": "fault",
     "online.publish": "fault",
     "health.evaluate": "fault",

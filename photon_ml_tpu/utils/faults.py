@@ -55,6 +55,12 @@ Injection sites currently threaded (ctx keys in parentheses):
   solve.poison      after a coordinate solve       (coordinate, iteration)
                     — action "poison" corrupts the solve result with NaNs
                     instead of raising, exercising the quarantine path
+  solve.local       one chunk's stochastic local   (chunk, epoch)
+                    solve (ops/chunked.py stochastic_pass, epoch = the
+                    pass index); transient faults retry the chunk's
+                    local epochs (the kernel is deterministic, so the
+                    retry is bit-exact), fatal ones raise
+                    LocalSolveError naming the chunk
   online.solve      online updater micro-batch     (coordinate)
                     solve (online/updater.py); transient faults retry with
                     the staging backoff discipline, "poison" corrupts the
@@ -131,6 +137,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "model.save": ("directory",),
     "model.load": ("directory",),
     "solve.poison": ("coordinate", "iteration"),
+    "solve.local": ("chunk", "epoch"),
     "online.solve": ("coordinate",),
     "online.publish": ("coordinate",),
     "health.evaluate": ("kind",),

@@ -43,6 +43,37 @@ def test_bench_smoke_end_to_end(tmp_path):
         assert 0.0 <= stats["host_blocked_frac"] <= 1.0
 
 
+def test_stoch_smoke(tmp_path):
+    """bench.py --stoch --smoke end-to-end in tier-1 (ISSUE 15 satellite):
+    the stochastic solver lane's hard gates — examples_per_staged_byte >=
+    1.5x the host-stepped LBFGS mirror on an out-of-core shape, f64
+    fixed-point parity <= 1e-6 after the polish, zero fresh traces across
+    warm epochs, and mesh objective-history parity — run on every tier-1
+    pass, so the lane cannot silently regress into re-staging or
+    divergence."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_stoch.json"
+    result = bench.stoch_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["all_gates_ok"] is True
+    assert detail["ratio_ok"] and result["value"] >= 1.5
+    assert detail["parity_ok"] and detail["traces_ok"]
+    assert detail["data_exceeds_budget"] and detail["under_budget"]
+    oc = next(e for e in detail["entries"]
+              if e["name"] == "stoch_out_of_core")
+    assert oc["fixed_point_rel_gap"] <= 1e-6
+    # the pinned chunks really did multiple local epochs per staging
+    sp = oc["stochastic_polish"]
+    assert sp["local_epochs"] > sp["chunks_staged"]
+    if detail["mesh_parity_ok"] is not None:
+        assert detail["mesh_parity_ok"] is True
+
+
 def test_stream_smoke(tmp_path):
     """bench.py --stream --smoke end-to-end in tier-1 (ISSUE 3 satellite):
     the out-of-core harness — ChunkedGLMObjective streaming, HBM-budgeted
