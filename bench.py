@@ -3893,6 +3893,478 @@ def health_bench(out_path="BENCH_health.json", smoke=False, max_wall=None):
 
 
 # --------------------------------------------------------------------------
+# --refit: continuous training loop (photon_ml_tpu/refit/)
+# --------------------------------------------------------------------------
+
+def _refit_service(rng, tmp, *, smoke, health=False, E=None,
+                   latency_window=None, **hc_kw):
+    """Serving stack with the durable feedback lane armed — every
+    admitted feedback batch lands in tmp/fb before intake returns."""
+    from photon_ml_tpu.online import OnlineUpdateConfig
+    from photon_ml_tpu.serving import ScoringService, ServingConfig
+    E = E if E is not None else (200 if smoke else 1000)
+    cfg_kw = {"max_batch": 256, "min_bucket": 8}
+    if latency_window is not None:
+        cfg_kw["latency_window"] = latency_window
+    svc = ScoringService(
+        model=_online_model(rng, 16, 8, E),
+        config=ServingConfig(**cfg_kw),
+        updates=OnlineUpdateConfig(micro_batch=8),
+        start_updater=False,
+        health=_health_config(smoke, **hc_kw) if health else None,
+        feedback_log_dir=os.path.join(tmp, "fb"))
+    return svc, [f"u{i}" for i in range(E)]
+
+
+def _refit_driver(svc, tmp, *, smoke, **cfg_kw):
+    """Compactor (registered on the lane for bounded retention) + warm
+    refit driver over the service's own registry."""
+    from photon_ml_tpu.refit import (CompactorConfig, LogCompactor,
+                                     RefitConfig, RefitDriver)
+    comp = LogCompactor(svc.feedback_log, os.path.join(tmp, "chunks"),
+                        CompactorConfig(chunk_rows=128 if smoke else 512))
+    svc.feedback_log.register_consumer("refit-compactor",
+                                       comp.checkpoint_seq)
+    cfg_kw.setdefault("outer_iterations", 1 if smoke else 2)
+    cfg_kw.setdefault("fe_iterations", 20 if smoke else 50)
+    cfg_kw.setdefault("re_iterations", 30 if smoke else 80)
+    driver = RefitDriver(svc.registry, comp, os.path.join(tmp, "models"),
+                         RefitConfig(**cfg_kw), metrics=svc.metrics)
+    return driver, comp
+
+
+def _refit_parity_entry(smoke: bool, tmp: str) -> dict:
+    """Gate: a refit FROM THE LOG is the same fit as one from the
+    identical rows in memory — f64 objective histories and final
+    coefficients agree to <= 1e-6 (the log -> chunk -> dataset path adds
+    nothing and loses nothing; array transport is raw-byte exact)."""
+    rng = np.random.default_rng(211)
+    tmp = os.path.join(tmp, "parity")
+    svc, entities = _refit_service(rng, tmp, smoke=smoke)
+    try:
+        n_batches, rows = (5, 96) if smoke else (10, 256)
+        batches = []
+        for _ in range(n_batches):
+            f, i, y = _calibrated_batch(svc, rng, entities, rows,
+                                        flip=True)
+            svc.feedback(f, i, y)
+            batches.append((f, i, y))
+        driver, comp = _refit_driver(svc, tmp, smoke=smoke)
+        comp.compact()
+        fit_log = driver.fit_candidate(driver.gather_rows())
+        n = n_batches * rows
+        rows_mem = {
+            "features": {s: np.concatenate([b[0][s] for b in batches])
+                         for s in batches[0][0]},
+            "ids": {"userId": np.concatenate(
+                [b[1]["userId"] for b in batches])},
+            "labels": np.concatenate([b[2] for b in batches]),
+            "weights": np.ones(n), "offsets": np.zeros(n),
+            "wall": np.zeros(n)}
+        fit_mem = driver.fit_candidate(rows_mem)
+        hist_log = np.asarray(fit_log.objective_history, np.float64)
+        hist_mem = np.asarray(fit_mem.objective_history, np.float64)
+        same_len = hist_log.shape == hist_mem.shape
+        hist_diff = (float(np.max(np.abs(hist_log - hist_mem)))
+                     if same_len else float("inf"))
+        fe_diff = float(np.max(np.abs(
+            np.asarray(fit_log.model.coordinates["fixed"]
+                       .glm.coefficients.means, np.float64)
+            - np.asarray(fit_mem.model.coordinates["fixed"]
+                         .glm.coefficients.means, np.float64))))
+        re_diff = float(np.max(np.abs(
+            np.asarray(fit_log.model.coordinates["perUser"].coefficients,
+                       np.float64)
+            - np.asarray(fit_mem.model.coordinates["perUser"].coefficients,
+                         np.float64))))
+        manifest = comp.manifest()
+        return {
+            "name": "refit_parity",
+            "log_rows": n, "sealed_rows": int(manifest["sealed_rows"]),
+            "sealed_chunks": len(manifest["chunks"]),
+            "history_len": [int(hist_log.size), int(hist_mem.size)],
+            "history_max_abs_diff": hist_diff,
+            "fe_max_abs_diff": fe_diff, "re_max_abs_diff": re_diff,
+            "parity_gate": 1e-6,
+            "parity_ok": bool(same_len and hist_diff <= 1e-6
+                              and fe_diff <= 1e-6 and re_diff <= 1e-6),
+        }
+    finally:
+        svc.close()
+
+
+def _refit_loop_entry(smoke: bool, tmp: str) -> dict:
+    """Gate: the closed loop end to end — injected label-flip drift trips
+    a health gate (updater pauses), the on-trip trigger fires a cycle
+    (compact -> warm refit -> tail validation -> swap), the swap resets
+    every gate and resumes the updater, and a post-swap stationary window
+    records ZERO fresh trips (the refit actually fixed the model)."""
+    from photon_ml_tpu.refit import RefitTrigger, TriggerConfig
+    rng = np.random.default_rng(223)
+    tmp = os.path.join(tmp, "loop")
+    svc, entities = _refit_service(
+        rng, tmp, smoke=smoke, health=True,
+        window_labels=64 if smoke else 128,
+        window_scores=256, baseline_scores=256)
+    try:
+        cfg = svc.health.config
+        for lo in range(0, cfg.baseline_scores + cfg.window_scores, 256):
+            f, i, _ = _calibrated_batch(svc, rng, entities, 256)
+            svc.score(f, i)
+        for _ in range(2):
+            f, i, y = _calibrated_batch(svc, rng, entities,
+                                        cfg.window_labels)
+            svc.feedback(f, i, y)
+            svc.updater.flush()
+        incumbent_version = svc.registry.version
+        assert svc.healthz()["status"] == "ok"
+        windows_to_trip = None
+        for w in range(1, 8):
+            f, i, y = _calibrated_batch(svc, rng, entities,
+                                        cfg.window_labels, flip=True)
+            svc.feedback(f, i, y)
+            if svc.healthz()["status"] == "degraded":
+                windows_to_trip = w
+                break
+        tripped = windows_to_trip is not None
+        paused = bool(svc.updater.paused)
+        driver, _comp = _refit_driver(svc, tmp, smoke=smoke)
+        trigger = RefitTrigger(driver, health=svc.health,
+                               config=TriggerConfig(mode="on_trip",
+                                                    trip_polls=2,
+                                                    cooloff_s=0.0))
+        t_cycle = time.perf_counter()
+        result = None
+        polls = 0
+        while result is None and polls < 4:
+            polls += 1
+            result = trigger.poll()
+        cycle_wall_s = time.perf_counter() - t_cycle
+        swapped = bool(result is not None and result.swapped)
+        post = svc.health.verdict()
+        gates_reset = bool(
+            post["status"] == "ok"
+            and not post["updates_paused_by_health"]
+            and not any(g["tripped"] for g in post["gates"].values()))
+        resumed = not svc.updater.paused
+        # post-swap stationary window: fresh drift baseline + calibrated
+        # traffic against the NEW model — zero trips means the candidate
+        # is calibrated to the drifted world it was trained on
+        trips_before = svc.metrics_snapshot()["health"]["gate_trips"]
+        for lo in range(0, cfg.baseline_scores + cfg.window_scores, 256):
+            f, i, _ = _calibrated_batch(svc, rng, entities, 256)
+            svc.score(f, i)
+        for _ in range(2):
+            f, i, y = _calibrated_batch(svc, rng, entities,
+                                        cfg.window_labels)
+            svc.feedback(f, i, y)
+            svc.updater.flush()
+        post_trips = (svc.metrics_snapshot()["health"]["gate_trips"]
+                      - trips_before)
+        refit_snap = svc.metrics_snapshot()["refit"]
+        return {
+            "name": "refit_loop",
+            "windows_to_trip": windows_to_trip,
+            "updater_paused_on_trip": paused,
+            "trigger_polls": polls,
+            "swapped": swapped,
+            "incumbent_version": incumbent_version,
+            "candidate_version": None if result is None else result.version,
+            "candidate": None if result is None else result.candidate,
+            "incumbent": None if result is None else result.incumbent,
+            "cycle_wall_s": round(cycle_wall_s, 3),
+            "gates_reset": gates_reset,
+            "updater_resumed": resumed,
+            "post_swap_trips": int(post_trips),
+            "post_swap_status": svc.healthz()["status"],
+            "refit_metrics": refit_snap,
+            "loop_ok": bool(tripped and paused and swapped and gates_reset
+                            and resumed and post_trips == 0
+                            and refit_snap["swaps"] >= 1),
+        }
+    finally:
+        svc.close()
+
+
+def _refit_latency_entry(smoke: bool, tmp: str) -> dict:
+    """Gate: scoring p99 while a refit runs <= 1.2x the no-refit
+    baseline (multi-core hosts; on one core the ratio is measured and
+    reported ungated — the fleet_scaling policy — because the child and
+    the scoring threads timeshare the only core no matter how nice the
+    child is).  The refit runs where a latency-sensitive fleet runs it:
+    OUT of the serving process, as the cli.refit batch job at nice 19.
+    (In-process, scoring and training share one XLA intra-op threadpool,
+    so the fit's large kernels head-of-line-block every scoring request
+    — measured at >20x p99 here; the in-process trigger trades that for
+    orchestration simplicity and the loop leg exercises it.  A separate
+    low-priority process is the standard posture: the OS preempts the
+    batch job whenever a request needs a core.)  Median-of-reps both
+    sides (one quiet or one noisy rep must not decide the verdict on a
+    shared-core host); the child keeps refit cycles in flight
+    (--interval) across every measured stream."""
+    import signal
+    from concurrent.futures import ThreadPoolExecutor
+
+    from photon_ml_tpu.models.io import save_game_model
+
+    rng = np.random.default_rng(227)
+    tmp = os.path.join(tmp, "lat")
+    d_g, d_u = 16, 8
+    n_requests = 150 if smoke else max(int(1000 * _SCALE), 800)
+    threads = 8
+    svc, entities = _refit_service(rng, tmp, smoke=smoke,
+                                   E=400 if smoke else 2000,
+                                   latency_window=n_requests)
+    E = len(entities)
+    requests = []
+    for _ in range(n_requests):
+        k = int(rng.integers(1, 9))
+        requests.append((
+            {"global": rng.normal(size=(k, d_g)),
+             "per_user": rng.normal(size=(k, d_u))},
+            {"userId": np.asarray(
+                [entities[rng.integers(0, E)] for _ in range(k)],
+                dtype=object)}))
+
+    def run_stream():
+        errors = []
+
+        def one(req):
+            try:
+                svc.score(*req)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            list(pool.map(one, requests))
+        return svc.metrics_snapshot()["latency_ms"]["p99"], errors
+
+    proc = None
+    try:
+        for _ in range(4 if smoke else 8):
+            f, i, y = _calibrated_batch(svc, rng, entities,
+                                        128 if smoke else 512, flip=True)
+            svc.feedback(f, i, y)
+        incumbent_dir = os.path.join(tmp, "incumbent")
+        model_root = os.path.join(tmp, "models")
+        save_game_model(svc.registry.scorer.model, incumbent_dir)
+        run_stream()                                   # warm buckets
+        reps = 2 if smoke else 3
+        base_p99s, base_errs = [], []
+        for _ in range(reps):
+            p99, e = run_stream()
+            base_p99s.append(p99)
+            base_errs += e
+        here = os.path.dirname(os.path.abspath(__file__))
+        out_log = os.path.join(tmp, "refit-cli.log")
+        cmd = ["nice", "-n", "19",
+               sys.executable, "-m", "photon_ml_tpu.cli.refit",
+               "--model-dir", incumbent_dir,
+               "--feedback-log", os.path.join(tmp, "fb"),
+               "--chunks", os.path.join(tmp, "chunks"),
+               "--model-root", model_root,
+               "--chunk-rows", "128" if smoke else "512",
+               "--outer-iterations", "1" if smoke else "2",
+               "--fe-iterations", "20" if smoke else "50",
+               "--re-iterations", "30" if smoke else "80",
+               "--interval", "0.2", "--poll", "0.05"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=here)
+        with open(out_log, "w") as log_f:
+            proc = subprocess.Popen(cmd, env=env, cwd=here, stdout=log_f,
+                                    stderr=subprocess.STDOUT)
+        # hold until the child's FIRST cycle lands a candidate (imports,
+        # compaction, and the training path's XLA compiles all happen
+        # there) — the measured streams then overlap warm steady-state
+        # cycles, which --interval keeps continuously in flight
+        deadline = time.perf_counter() + 300
+        while time.perf_counter() < deadline and proc.poll() is None:
+            if os.path.isdir(model_root) and os.listdir(model_root):
+                break
+            time.sleep(0.2)
+        first_cycle = os.path.isdir(model_root) and bool(
+            os.listdir(model_root))
+        during_p99s, during_errs = [], []
+        overlapped = 0
+        for _ in range(reps):
+            alive_before = proc.poll() is None
+            p99, e = run_stream()
+            during_p99s.append(p99)
+            during_errs += e
+            overlapped += int(alive_before and proc.poll() is None)
+        proc.send_signal(signal.SIGINT)
+        try:
+            child_rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            child_rc = proc.wait()
+        with open(out_log) as log_f:
+            cycles = sum(1 for line in log_f if '"swapped"' in line)
+        # a cycle that was still publishing at SIGINT shows up as its
+        # swap's version directory rather than a printed result line
+        swap_dirs = (len(os.listdir(model_root))
+                     if os.path.isdir(model_root) else 0)
+        # median-of-reps, not min: one quiet (or one noisy) rep must not
+        # decide the verdict on a shared-core host
+        base_p99 = float(np.median(base_p99s))
+        during_p99 = float(np.median(during_p99s))
+        ratio = during_p99 / max(base_p99, 1e-9)
+        cores = os.cpu_count() or 1
+        latency_gated = cores >= 2
+        out = {
+            "name": "refit_latency",
+            "requests": n_requests, "threads": threads, "reps": reps,
+            "baseline_p99_ms": base_p99,
+            "baseline_p99_ms_reps": base_p99s,
+            "during_p99_ms": during_p99,
+            "during_p99_ms_reps": during_p99s,
+            "refit_cycles": cycles,
+            "refit_swap_dirs": swap_dirs,
+            "first_cycle_before_measurement": first_cycle,
+            "child_rc": child_rc,
+            "overlapped_reps": overlapped,
+            "host_cores": cores,
+            "p99_ratio": round(ratio, 3),
+            "latency_gate": 1.2,
+            "latency_gated": latency_gated,
+        }
+        if not latency_gated:
+            out["latency_gate_waived"] = (
+                f"single-core host (os.cpu_count()={cores}): the refit "
+                "child and the scoring threads timeshare ONE core, so "
+                "even at nice 19 the child's scheduler slices inflate "
+                "scoring tails — the ratio is measured and reported "
+                "ungated; it arms as a hard gate on any multi-core "
+                "host, where the preempted child costs serving nothing")
+        out["latency_ok"] = bool(
+            not base_errs and not during_errs and first_cycle
+            and (cycles >= 1 or swap_dirs >= 1) and child_rc == 0
+            and overlapped == reps
+            and (ratio <= 1.2 or not latency_gated))
+        return out
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        svc.close()
+
+
+def _refit_traces_entry(smoke: bool, tmp: str) -> dict:
+    """Gate: ZERO fresh XLA traces in the serving path across the swap —
+    scoring rounds before the cycle and scoring rounds against the
+    freshly installed candidate both trace nothing (install warms the
+    candidate's bucket programs OFF the request path, the same
+    discipline every other swap leg gates)."""
+    rng = np.random.default_rng(229)
+    tmp = os.path.join(tmp, "traces")
+    svc, entities = _refit_service(rng, tmp, smoke=smoke)
+    try:
+        for _ in range(4 if smoke else 6):
+            f, i, y = _calibrated_batch(svc, rng, entities, 96,
+                                        flip=True)
+            svc.feedback(f, i, y)
+        driver, _comp = _refit_driver(svc, tmp, smoke=smoke)
+
+        def score_round(seed):
+            r = np.random.default_rng(seed)
+            f, i, _ = _calibrated_batch(svc, r, entities, 64)
+            svc.score(f, i)
+
+        for s in range(2):                       # warm bucket programs
+            score_round(s)
+        rounds = 3 if smoke else 8
+        with _trace_counting() as before:
+            for s in range(10, 10 + rounds):
+                score_round(s)
+        version_before = svc.registry.version
+        result = driver.run_once()
+        with _trace_counting() as after:
+            for s in range(20, 20 + rounds):
+                score_round(s)
+        return {
+            "name": "refit_traces",
+            "rounds_per_side": rounds,
+            "swapped": bool(result.swapped),
+            "version_before": version_before,
+            "version_after": svc.registry.version,
+            "fresh_traces_before_swap": before.count,
+            "fresh_traces_after_swap": after.count,
+            "zero_traces_ok": bool(before.count == 0 and after.count == 0
+                                   and result.swapped
+                                   and svc.registry.version
+                                   != version_before),
+        }
+    finally:
+        svc.close()
+
+
+def refit_bench(out_path="BENCH_refit.json", smoke=False, max_wall=None):
+    """Continuous-training gate (--refit): (1) f64 refit-from-log parity
+    <= 1e-6 vs the identical rows in memory; (2) the closed loop —
+    drift trip -> compact -> warm refit -> tail validation -> swap ->
+    gates reset -> zero trips across a post-swap stationary window;
+    (3) scoring p99 during an out-of-process (cli.refit, nice 19) refit
+    <= 1.2x baseline on multi-core hosts (measured, ungated on one
+    core); (4) zero fresh XLA traces in the serving path across the
+    swap.  `value` is the end-to-end trip-to-recovery cycle wall."""
+    import tempfile
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    t0 = time.perf_counter()
+    entries = []
+    truncated = []
+    with tempfile.TemporaryDirectory() as tmp:
+        legs = [
+            ("refit_parity", _refit_parity_entry),
+            ("refit_loop", _refit_loop_entry),
+            ("refit_traces", _refit_traces_entry),
+            ("refit_latency", _refit_latency_entry),
+        ]
+        for name, fn in legs:
+            if max_wall is not None and time.perf_counter() - t0 > max_wall:
+                truncated.append(name)
+                continue
+            entries.append(fn(smoke, tmp))
+    by_name = {e["name"]: e for e in entries}
+    parity = by_name.get("refit_parity", {})
+    loop = by_name.get("refit_loop", {})
+    traces = by_name.get("refit_traces", {})
+    latency = by_name.get("refit_latency", {})
+    gates = {
+        "parity_ok": parity.get("parity_ok"),
+        "loop_ok": loop.get("loop_ok"),
+        "zero_traces_ok": traces.get("zero_traces_ok"),
+        "latency_ok": latency.get("latency_ok"),
+    }
+    # latency is a smoke SIGNAL under the tier-1 suite (shared cores), a
+    # HARD gate on the committed full run — same policy as --online
+    hard = ["parity_ok", "loop_ok", "zero_traces_ok"]
+    if not smoke:
+        hard.append("latency_ok")
+    result = {
+        "metric": "refit_trip_to_recovery_wall_s",
+        "value": loop.get("cycle_wall_s"),
+        "unit": "seconds",
+        "detail": {
+            "smoke": smoke,
+            "entries": entries,
+            **gates,
+            "all_ok": all(bool(gates[g]) for g in hard),
+            "hard_gates": hard,
+            "truncated": truncated or False,
+            "suite_wall_s": round(time.perf_counter() - t0, 1),
+        },
+    }
+    _embed_telemetry(result)
+    tmp_path = out_path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp_path, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 # --fleet: replicated serving (photon_ml_tpu/fleet/)
 # --------------------------------------------------------------------------
 
@@ -5716,6 +6188,13 @@ def _dispatch():
                  and (i == 0 or rest[i - 1] != "--max-wall")]
         health_bench(*(paths[:1] or ["BENCH_health.json"]), smoke=smoke,
                      max_wall=_parse_max_wall(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--refit":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        refit_bench(*(paths[:1] or ["BENCH_refit.json"]), smoke=smoke,
+                    max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--pipeline":
         pipeline_bench(*sys.argv[2:3])
     elif len(sys.argv) > 1 and sys.argv[1] == "--stream":

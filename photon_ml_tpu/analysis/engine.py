@@ -33,6 +33,7 @@ DURABLE_MODULE_SUFFIXES = (
     "fleet/replog.py",
     "fleet/replica.py",
     "store/cold.py",
+    "refit/compactor.py",
 )
 DURABLE_IMPL_SUFFIX = "utils/durable.py"
 

@@ -17,7 +17,10 @@ from photon_ml_tpu.fleet.front import (FRONT_SNAPSHOT_PATHS,  # noqa: F401
 from photon_ml_tpu.fleet.replica import (FleetPublisher,  # noqa: F401
                                          Replica, ReplicaConfig,
                                          ReplicaError)
-from photon_ml_tpu.fleet.replog import (ReplicationLog,  # noqa: F401
+from photon_ml_tpu.fleet.replog import (FeedbackLog,  # noqa: F401
+                                        ReplicationLog,
                                         ReplicationLogError, decode_array,
                                         delta_from_record, encode_array,
-                                        record_for_event)
+                                        feedback_from_record,
+                                        record_for_event,
+                                        record_for_feedback)

@@ -31,6 +31,15 @@ Fault sites (utils.faults.SITES): `replog.append` fires before each
 record write (transient -> the publisher's retry-with-backoff absorbs
 it), `replog.read` before each tail read (transient -> the replica's
 poll-loop retry absorbs it).
+
+Feedback lane (`FeedbackLog`): labeled-observation batches admitted by
+the online updater land in sibling `feedback-*.seg` segments with the
+SAME sha256/torn-tail/fsync discipline, so the refit compactor
+(photon_ml_tpu/refit/) replays a complete training source from the
+fleet's own exhaust.  Compaction on either lane is bounded by registered
+consumers (`register_consumer`): folding past the newest seq a refit
+compactor checkpoint still needs would strand the compactor exactly the
+way folding past a replica's applied seq strands the replica.
 """
 from __future__ import annotations
 
@@ -40,10 +49,11 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.utils import durable, faults, locktrace
 
 
@@ -103,6 +113,11 @@ def _parse_line(line: str) -> Optional[dict]:
 
 
 class ReplicationLog:
+    #: segment naming, overridable by sibling lanes (FeedbackLog)
+    _PREFIX = _SEGMENT_PREFIX
+    _SUFFIX = _SEGMENT_SUFFIX
+    _SNAP = _SNAPSHOT_NAME
+
     def __init__(self, log_dir: str, segment_records: int = SEGMENT_RECORDS):
         self.log_dir = str(log_dir)
         self.segment_records = int(segment_records)
@@ -111,6 +126,11 @@ class ReplicationLog:
                                        "ReplicationLog._lock")
         self._appending = False                 # photonlint: guarded-by=_lock
         self._head_seq: Optional[int] = None    # photonlint: guarded-by=_lock
+        # compaction consumers: name -> checkpoint_fn() returning the
+        # newest seq that consumer has durably absorbed.  compact() never
+        # folds past the minimum — a refit compactor's unread tail is as
+        # load-bearing as a replica's unapplied tail.
+        self._consumers: Dict[str, Callable[[], int]] = {}
 
     # -- segment bookkeeping -------------------------------------------------
 
@@ -120,17 +140,17 @@ class ReplicationLog:
         except FileNotFoundError:
             return []
         return sorted(n for n in names
-                      if n.startswith(_SEGMENT_PREFIX)
-                      and n.endswith(_SEGMENT_SUFFIX))
+                      if n.startswith(self._PREFIX)
+                      and n.endswith(self._SUFFIX))
 
-    @staticmethod
-    def _first_seq_of(name: str) -> int:
-        return int(name[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)])
+    @classmethod
+    def _first_seq_of(cls, name: str) -> int:
+        return int(name[len(cls._PREFIX):-len(cls._SUFFIX)])
 
     def _segment_path(self, first_seq: int) -> str:
         return os.path.join(
             self.log_dir,
-            f"{_SEGMENT_PREFIX}{first_seq:010d}{_SEGMENT_SUFFIX}")
+            f"{self._PREFIX}{first_seq:010d}{self._SUFFIX}")
 
     def _scan_segment(self, name: str) -> List[dict]:
         """Parse one segment; a torn LAST line is dropped, a bad record
@@ -275,20 +295,73 @@ class ReplicationLog:
     # -- snapshot + compaction ----------------------------------------------
 
     def latest_snapshot(self) -> Optional[dict]:
-        path = os.path.join(self.log_dir, _SNAPSHOT_NAME)
+        path = os.path.join(self.log_dir, self._SNAP)
         if not os.path.exists(path):
             return None
         with open(path) as f:
             return json.load(f)
+
+    # -- bounded retention (compaction consumers) -----------------------------
+
+    def register_consumer(self, name: str,
+                          checkpoint_fn: Callable[[], int]) -> None:
+        """Register a compaction consumer (e.g. the refit compactor):
+        `checkpoint_fn()` returns the newest log seq that consumer has
+        durably absorbed, and `compact()` refuses to fold past the
+        minimum across all registered consumers — records a checkpoint
+        still needs stay readable."""
+        with self._lock:
+            self._consumers[str(name)] = checkpoint_fn
+
+    def unregister_consumer(self, name: str) -> None:
+        with self._lock:
+            self._consumers.pop(str(name), None)
+
+    def _retention_clamp(self, upto_seq: int) -> int:
+        with self._lock:
+            fns = dict(self._consumers)
+        for fn in fns.values():
+            upto_seq = min(upto_seq, int(fn()))
+        return upto_seq
+
+    def _note_compacted(self, *, upto_seq: int, requested_seq: int,
+                        folded: int, segments_deleted: int) -> None:
+        telemetry.event("replog.compacted", lane=type(self).__name__,
+                        upto_seq=upto_seq, requested_seq=requested_seq,
+                        folded=folded, segments_deleted=segments_deleted,
+                        clamped=upto_seq < requested_seq)
+        telemetry.counter("replog.compacted").inc()
+
+    def _drop_covered_segments(self, upto_seq: int) -> int:
+        """Delete segments whose every record is <= upto_seq; returns the
+        number removed."""
+        segments = self._segments()
+        dropped = 0
+        for i, name in enumerate(segments):
+            nxt = (self._first_seq_of(segments[i + 1])
+                   if i + 1 < len(segments) else None)
+            if nxt is not None and nxt - 1 <= upto_seq:
+                os.remove(os.path.join(self.log_dir, name))
+                dropped += 1
+            elif nxt is None:
+                records = self._scan_segment(name)
+                if records and int(records[-1]["log_seq"]) <= upto_seq:
+                    os.remove(os.path.join(self.log_dir, name))
+                    dropped += 1
+        durable.fsync_dir(self.log_dir)
+        return dropped
 
     def compact(self, upto_seq: int) -> Optional[dict]:
         """Fold every record with log_seq <= upto_seq into a snapshot —
         the net row state per coordinate vs the base model directory —
         then delete segments wholly covered by it.  `upto_seq` must be
         the minimum APPLIED seq across live replicas (folding records a
-        replica has not applied would strand it).  Returns the snapshot
-        (None when there is nothing to fold)."""
-        upto_seq = int(upto_seq)
+        replica has not applied would strand it), and is additionally
+        clamped to the minimum registered consumer checkpoint (a refit
+        compactor's unread tail is never folded away).  Returns the
+        snapshot (None when there is nothing to fold)."""
+        requested = int(upto_seq)
+        upto_seq = self._retention_clamp(requested)
         snap = self.latest_snapshot()
         if upto_seq <= (int(snap["upto_seq"]) if snap else 0):
             return snap
@@ -303,20 +376,29 @@ class ReplicationLog:
             return snap
         new_snap = state.to_snapshot()
         durable.atomic_write_json(
-            os.path.join(self.log_dir, _SNAPSHOT_NAME), new_snap)
-        # drop segments whose every record is covered by the snapshot
-        segments = self._segments()
-        for i, name in enumerate(segments):
-            nxt = (self._first_seq_of(segments[i + 1])
-                   if i + 1 < len(segments) else None)
-            if nxt is not None and nxt - 1 <= upto_seq:
-                os.remove(os.path.join(self.log_dir, name))
-            elif nxt is None:
-                records = self._scan_segment(name)
-                if records and int(records[-1]["log_seq"]) <= upto_seq:
-                    os.remove(os.path.join(self.log_dir, name))
-        durable.fsync_dir(self.log_dir)
+            os.path.join(self.log_dir, self._SNAP), new_snap)
+        dropped = self._drop_covered_segments(upto_seq)
+        self._note_compacted(upto_seq=upto_seq, requested_seq=requested,
+                             folded=folded, segments_deleted=dropped)
         return new_snap
+
+    # -- lane accounting (fleet.log_records / fleet.log_bytes gauges) ---------
+
+    def live_records(self) -> int:
+        """Records currently held in durable segments (excludes history
+        folded into the snapshot)."""
+        return sum(self._count_records(os.path.join(self.log_dir, name))
+                   for name in self._segments())
+
+    def live_bytes(self) -> int:
+        """Bytes currently held in durable segments."""
+        total = 0
+        for name in self._segments():
+            try:
+                total += os.path.getsize(os.path.join(self.log_dir, name))
+            except FileNotFoundError:
+                pass
+        return total
 
 
 class _FoldState:
@@ -467,3 +549,110 @@ def delta_from_record(rec: dict):
                                   prior=decode_array(enc["prior"]))
             for lane, enc in rec["coordinates"].items()},
         created_at=float(rec.get("created_at", 0.0)))
+
+
+# -- feedback lane (labeled-observation exhaust) ------------------------------
+
+_FEEDBACK_PREFIX = "feedback-"
+_FEEDBACK_SUFFIX = ".seg"
+_FEEDBACK_SNAPSHOT_NAME = "feedback-snapshot.json"
+
+
+class FeedbackLog(ReplicationLog):
+    """Sibling durable lane for admitted labeled feedback batches: the
+    refit compactor's complete labeled-observation source.
+
+    Same single-writer, sha256-per-record, torn-tail-truncating,
+    fsynced-segment discipline as the model-state log, with `feedback-`
+    `.seg` segment naming so one directory can host both lanes.  There is
+    no row-state fold here — the refit compactor's sealed chunk files ARE
+    this lane's compacted form — so `compact(upto_seq)` prunes covered
+    segments and records the pruned horizon in a marker snapshot
+    (`feedback-snapshot.json`, so `head_seq()` and compacted-history
+    reads keep the base class's semantics).  Retention is bounded by
+    registered consumers exactly like the model lane."""
+
+    _PREFIX = _FEEDBACK_PREFIX
+    _SUFFIX = _FEEDBACK_SUFFIX
+    _SNAP = _FEEDBACK_SNAPSHOT_NAME
+
+    def compact(self, upto_seq: int) -> Optional[dict]:
+        """Prune segments wholly covered by `upto_seq` (clamped to the
+        minimum registered consumer checkpoint) and persist the pruned
+        horizon.  Returns the marker snapshot."""
+        requested = int(upto_seq)
+        upto_seq = self._retention_clamp(requested)
+        snap = self.latest_snapshot()
+        prev = int(snap["upto_seq"]) if snap else 0
+        if upto_seq <= prev:
+            return snap
+        covered = sum(
+            1 for env in self.read(prev)
+            if int(env["log_seq"]) <= upto_seq)
+        if covered == 0:
+            return snap
+        new_snap = {"format_version": 1, "kind": "feedback",
+                    "upto_seq": upto_seq, "created_at": time.time()}
+        durable.atomic_write_json(
+            os.path.join(self.log_dir, self._SNAP), new_snap)
+        dropped = self._drop_covered_segments(upto_seq)
+        self._note_compacted(upto_seq=upto_seq, requested_seq=requested,
+                             folded=covered, segments_deleted=dropped)
+        with self._lock:
+            self._head_seq = None  # recompute against the new horizon
+        return new_snap
+
+
+def record_for_feedback(features: Dict[str, np.ndarray],
+                        ids: Dict[str, np.ndarray],
+                        labels: np.ndarray,
+                        weights: Optional[np.ndarray] = None,
+                        offsets: Optional[np.ndarray] = None,
+                        *,
+                        event_ids: Optional[List[str]] = None,
+                        trace_id: Optional[str] = None,
+                        wall_s: Optional[float] = None) -> dict:
+    """An admitted feedback batch -> its durable log record (bit-exact
+    float transport; raw entity ids as strings)."""
+    labels = np.asarray(labels, np.float64)
+    n = int(labels.shape[0])
+    weights = (np.ones(n) if weights is None
+               else np.asarray(weights, np.float64))
+    offsets = (np.zeros(n) if offsets is None
+               else np.asarray(offsets, np.float64))
+    rec = {"kind": "feedback", "rows": n,
+           "features": {s: encode_array(np.asarray(a, np.float64))
+                        for s, a in features.items()},
+           "ids": {t: [str(v) for v in np.asarray(a).tolist()]
+                   for t, a in ids.items()},
+           "labels": encode_array(labels),
+           "weights": encode_array(weights),
+           "offsets": encode_array(offsets),
+           "wall_s": float(time.time() if wall_s is None else wall_s)}
+    if event_ids is not None:
+        rec["event_ids"] = [None if e is None else str(e)
+                            for e in event_ids]
+    if trace_id:
+        rec["trace_id"] = str(trace_id)
+    return rec
+
+
+def feedback_from_record(rec: dict) -> dict:
+    """A "feedback" log record -> host arrays (the compactor's input):
+    {features: {shard: [n,d] f64}, ids: {type: [n] object}, labels,
+    weights, offsets, wall_s, event_ids, trace_id}."""
+    if rec.get("kind") != "feedback":
+        raise ReplicationLogError(
+            f"not a feedback record: kind={rec.get('kind')!r}")
+    return {
+        "features": {s: decode_array(enc)
+                     for s, enc in rec["features"].items()},
+        "ids": {t: np.asarray(v, dtype=object)
+                for t, v in rec["ids"].items()},
+        "labels": decode_array(rec["labels"]),
+        "weights": decode_array(rec["weights"]),
+        "offsets": decode_array(rec["offsets"]),
+        "wall_s": float(rec.get("wall_s", 0.0)),
+        "event_ids": rec.get("event_ids"),
+        "trace_id": rec.get("trace_id"),
+    }

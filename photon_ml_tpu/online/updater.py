@@ -105,16 +105,19 @@ class OnlineUpdater:
 
     def __init__(self, registry, metrics=None,
                  config: OnlineUpdateConfig = OnlineUpdateConfig(),
-                 emitter=None, health=None):
+                 emitter=None, health=None, feedback_log=None):
         """`health` (a health.HealthMonitor) receives per-delta magnitude
         and freeze vitals, and is what `pause()`/`resume()` exist for:
         the monitor's gates stop the update loop while the model is
-        degrading and restart it on recovery."""
+        degrading and restart it on recovery.  `feedback_log` (a
+        fleet.FeedbackLog) makes every admitted batch durable before
+        intake returns — the refit compactor's complete replay source."""
         self.registry = registry
         self.metrics = metrics
         self.config = config
         self.emitter = emitter
         self.health = health
+        self.feedback_log = feedback_log
         self.buffer = FeedbackBuffer(max_rows=config.max_pending_rows,
                                      entity_window=config.entity_window,
                                      dedup_window=config.dedup_window)
@@ -204,6 +207,13 @@ class OnlineUpdater:
             if self.metrics is not None:
                 self.metrics.observe_feedback_shed()
             raise
+        if self.feedback_log is not None:
+            # durable BEFORE intake returns: an admitted batch the refit
+            # compactor can never replay is an admitted batch lost to the
+            # next full refit
+            self._persist_feedback_with_retry(
+                feats, ids, labels, weights_a, offsets_a,
+                event_ids=event_ids, trace_id=trace_id, wall_s=wall_now)
         out.update({"rows": n, "dropped_unseen": unseen,
                     "dropped_frozen": frozen})
         if self.metrics is not None:
@@ -467,6 +477,40 @@ class OnlineUpdater:
             mask=jnp.asarray(mask), weights=jnp.asarray(weights),
             offsets=jnp.asarray(offsets))
         return blocks, rows, len(cells)
+
+    def _persist_feedback_with_retry(self, feats, ids, labels, weights,
+                                     offsets, *, event_ids, trace_id,
+                                     wall_s) -> int:
+        """Append one admitted batch to the durable feedback lane under
+        the standard transient retry/backoff discipline (the lane's
+        `replog.append` fault site fires with kind="feedback"), then
+        refresh the fleet.log_records/log_bytes gauges."""
+        from photon_ml_tpu.fleet.replog import record_for_feedback
+        cfg = self.config
+        rec = record_for_feedback(feats, ids, labels, weights, offsets,
+                                  event_ids=event_ids, trace_id=trace_id,
+                                  wall_s=wall_s)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                seq = self.feedback_log.append(rec)
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                if not faults.is_transient(e) or attempt >= cfg.max_attempts:
+                    raise
+                telemetry.event("online_feedback_log_retry",
+                                attempt=attempt,
+                                error=f"{type(e).__name__}: {e}")
+                time.sleep(cfg.backoff_s * (2 ** (attempt - 1))
+                           * (1.0 + 0.25 * self._jitter.random()))
+        if self.metrics is not None:
+            self.metrics.observe_feedback_log(
+                records=self.feedback_log.live_records(),
+                bytes=self.feedback_log.live_bytes())
+        return seq
 
     def _solve_with_retry(self, lane: str, blocks, prior):
         """The anchored solve under the staging retry discipline:

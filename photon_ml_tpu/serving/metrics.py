@@ -119,6 +119,12 @@ SNAPSHOT_PATHS = {
     "fleet.catchup_s": ("fleet", "catchup_s"),
     "fleet.apply_latency_s": ("fleet", "apply_latency_ms"),
     "fleet.feedback_visible_s": ("fleet", "feedback_visible_ms"),
+    "fleet.log_records": ("fleet", "log_records"),
+    "fleet.log_bytes": ("fleet", "log_bytes"),
+    "refit.runs": ("refit", "runs"),
+    "refit.swaps": ("refit", "swaps"),
+    "refit.failures": ("refit", "failures"),
+    "refit.last_success_age_s": ("refit", "last_success_age_s"),
 }
 
 
@@ -250,6 +256,21 @@ class ServingMetrics:
                                                 reservoir=latency_window)
         self._fleet_feedback_visible = r.histogram(
             "fleet.feedback_visible_s", reservoir=latency_window)
+        # durable feedback-lane size (FeedbackLog segments on disk): the
+        # refit compactor's raw-material backlog, and the retention
+        # pressure replog compaction relieves
+        self._fleet_log_records = r.gauge("fleet.log_records")
+        self._fleet_log_bytes = r.gauge("fleet.log_bytes")
+        # -- continuous-training tier (photon_ml_tpu/refit/) -----------------
+        # all zeros until a refit driver binds; last_success_age_s is -1
+        # until the first successful cycle (alert on it growing past the
+        # expected cadence — see COMPONENTS.md "Continuous training")
+        self._refit_runs = r.counter("refit.runs")
+        self._refit_swaps = r.counter("refit.swaps")
+        self._refit_failures = r.counter("refit.failures")
+        self._refit_age = r.gauge("refit.last_success_age_s")
+        self._refit_age.set(-1.0)
+        self._refit_last_success: Optional[float] = None  # photonlint: guarded-by=_lock
 
     # counter-value conveniences (tests and embedding callers read these
     # like the old plain-int attributes)
@@ -377,6 +398,27 @@ class ServingMetrics:
     def observe_replica_apply_retry(self) -> None:
         self._fleet_apply_retries.inc()
 
+    def observe_feedback_log(self, *, records: int, bytes: int) -> None:
+        """Durable feedback-lane size after an append or a compaction
+        (records/bytes live in `feedback-*.seg` segments)."""
+        self._fleet_log_records.set(int(records))
+        self._fleet_log_bytes.set(int(bytes))
+
+    def observe_refit_run(self, *, swapped: bool, failed: bool = False
+                          ) -> None:
+        """One completed refit cycle: every cycle counts a run, a winning
+        candidate counts a swap (and stamps last-success), a cycle that
+        died counts a failure."""
+        self._refit_runs.inc()
+        if failed:
+            self._refit_failures.inc()
+            return
+        if swapped:
+            self._refit_swaps.inc()
+        with self._lock:
+            self._refit_last_success = time.monotonic()
+        self._refresh_refit_age()
+
     def observe_update_cycle(self, *, entities: int, rows: int) -> None:
         with self._lock:
             self._updates.inc()
@@ -502,6 +544,15 @@ class ServingMetrics:
         self._model_age.set(round(age, 3))
         return age
 
+    def _refresh_refit_age(self) -> float:
+        """-1 until the first successful refit cycle, then the age of the
+        newest success — the staleness signal refit alerting scrapes."""
+        with self._lock:
+            last = self._refit_last_success
+        age = -1.0 if last is None else round(time.monotonic() - last, 3)
+        self._refit_age.set(age)
+        return age
+
     def _refresh_online_gauges(self) -> None:
         """Pull the updater's live vitals into the gauges (both render
         paths call this, so neither surface can go stale alone).
@@ -572,6 +623,7 @@ class ServingMetrics:
         out["health"] = self._health_snapshot()
         out["store"] = self._store_snapshot()
         out["fleet"] = self._fleet_snapshot()
+        out["refit"] = self._refit_snapshot()
         if model_version is not None:
             out["model_version"] = model_version
         return out
@@ -687,6 +739,18 @@ class ServingMetrics:
                 self._fleet_apply_latency.snapshot()),
             "feedback_visible_ms": self._latency_ms(
                 self._fleet_feedback_visible.snapshot()),
+            "log_records": self._fleet_log_records.value,
+            "log_bytes": self._fleet_log_bytes.value,
+        }
+
+    def _refit_snapshot(self) -> Dict:
+        """The continuous-training tier's state (all zeros / -1 when no
+        refit driver is bound — the instruments exist either way)."""
+        return {
+            "runs": self._refit_runs.value,
+            "swaps": self._refit_swaps.value,
+            "failures": self._refit_failures.value,
+            "last_success_age_s": self._refresh_refit_age(),
         }
 
     def prometheus(self, model_version: Optional[str] = None) -> str:
@@ -697,5 +761,6 @@ class ServingMetrics:
         self._refresh_model_age()
         self._refresh_online_gauges()
         self._refresh_store_counters()
+        self._refresh_refit_age()
         info = {"model_version": model_version} if model_version else None
         return prometheus_text(self.registry, extra_info=info)

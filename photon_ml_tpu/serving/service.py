@@ -56,7 +56,7 @@ class ScoringService:
                  model=None, config: Optional[ServingConfig] = None,
                  emitter: Optional[EventEmitter] = None,
                  updates=None, start_updater: bool = True,
-                 health=None):
+                 health=None, feedback_log_dir: Optional[str] = None):
         """`updates` (an online.OnlineUpdateConfig) enables the online
         learning tier: `feedback()` accepts labeled observations and a
         background OnlineUpdater re-solves ONLY the touched entities'
@@ -68,7 +68,14 @@ class ScoringService:
         streaming calibration over feedback-joined labels, score-
         distribution drift vs a per-install baseline, and gates that
         flip /healthz to degraded, pause the updater, and optionally
-        trigger the delta-aware rollback (cli.serve --health-config)."""
+        trigger the delta-aware rollback (cli.serve --health-config).
+
+        `feedback_log_dir` arms the durable feedback lane
+        (fleet.FeedbackLog): every admitted `feedback()` batch is
+        persisted with the replication log's sha256/torn-tail discipline
+        before intake returns, so a refit compactor
+        (photon_ml_tpu/refit/) can replay the fleet's own exhaust into
+        training chunks.  Requires `updates`."""
         if (model_dir is None) == (model is None):
             raise ValueError("pass exactly one of model_dir / model")
         self.config = config or ServingConfig()
@@ -136,12 +143,21 @@ class ScoringService:
             on_shed=self.metrics.observe_shed,
             on_deadline=self.metrics.observe_deadline)
         self.updater = None
+        self.feedback_log = None
+        if feedback_log_dir is not None and updates is None:
+            raise ValueError("feedback_log_dir requires updates (the "
+                             "feedback lane persists the online intake)")
         if updates is not None:
             from photon_ml_tpu.online import OnlineUpdater
+            if feedback_log_dir is not None:
+                from photon_ml_tpu.fleet.replog import FeedbackLog
+                self.feedback_log = FeedbackLog(feedback_log_dir)
+                self.feedback_log.recover()
             self.updater = OnlineUpdater(self.registry,
                                          metrics=self.metrics,
                                          config=updates, emitter=emitter,
-                                         health=self.health)
+                                         health=self.health,
+                                         feedback_log=self.feedback_log)
             self.metrics.set_online_probe(self.updater.probe)
             if start_updater:
                 self.updater.start()

@@ -43,6 +43,9 @@ EVENTS: Dict[str, str] = {
     "store.fetch": "fault",
     "store.promote": "fault",
     "store.spill": "fault",
+    "refit.compact": "fault",
+    "refit.validate": "fault",
+    "refit.swap": "fault",
     # -- flight-recorder triggers (telemetry.flight.TRIGGERS ->
     #    the `flight_dump` instant event) --------------------------------
     "health.gate_trip": "flight_dump",

@@ -106,6 +106,23 @@ Injection sites currently threaded (ctx keys in parentheses):
                     entity block (the segment stays in the write-back
                     buffer, so no row value is ever lost to a failed
                     spill)
+  refit.compact     one sealed training chunk     (chunk)
+                    written by the log compactor (refit/compactor.py);
+                    transient faults retry with the staging backoff
+                    discipline, a "kill" here is the canonical
+                    mid-compaction crash test (restart resumes from the
+                    durable checkpoint and converges to bit-identical
+                    chunk files), fatal ones raise CompactionError
+  refit.validate    candidate-vs-incumbent holdout (candidate)
+                    evaluation (refit/driver.py); transient faults retry,
+                    fatal ones abort the refit cycle with the incumbent
+                    still serving (no swap record is appended)
+  refit.swap        candidate publish into the     (version)
+                    serving registry (refit/driver.py install call
+                    site); transient faults retry with backoff, fatal
+                    ones leave the incumbent serving — the swap is the
+                    LAST step, so a failed publish never strands a
+                    half-installed candidate
 """
 from __future__ import annotations
 
@@ -147,6 +164,9 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "store.fetch": ("tier", "block"),
     "store.promote": ("coordinate", "rows"),
     "store.spill": ("block",),
+    "refit.compact": ("chunk",),
+    "refit.validate": ("candidate",),
+    "refit.swap": ("version",),
 }
 
 

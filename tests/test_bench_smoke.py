@@ -376,6 +376,62 @@ def test_health_smoke(tmp_path):
     assert latency["armed"]["score_windows"] > 0
 
 
+def test_refit_smoke(tmp_path):
+    """bench.py --refit --smoke end-to-end in tier-1 (ISSUE 16
+    satellite): the continuous-training harness — f64 refit-from-log
+    parity, the drift-trip -> compact -> warm refit -> validate -> swap
+    -> recovery loop, and the zero-fresh-traces-across-the-swap gate —
+    cannot rot without failing the normal test run.  The p99 gate is a
+    smoke SIGNAL here (shared-core CI; the nice'd cli.refit child
+    competes with the whole suite); the full bench run enforces it
+    hard."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_refit.json"
+    result = bench.refit_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    # refitting from the log is the IDENTICAL fit as from memory (f64)
+    parity = next(e for e in detail["entries"]
+                  if e["name"] == "refit_parity")
+    assert parity["parity_ok"] is True
+    assert parity["history_max_abs_diff"] <= parity["parity_gate"]
+    assert parity["sealed_chunks"] >= 1
+    # the closed loop: trip -> pause -> refit -> swap -> gates reset ->
+    # resume -> zero trips across a post-swap stationary window
+    loop = next(e for e in detail["entries"] if e["name"] == "refit_loop")
+    assert loop["loop_ok"] is True
+    assert loop["windows_to_trip"] is not None
+    assert loop["updater_paused_on_trip"] is True
+    assert loop["swapped"] is True
+    assert loop["candidate_version"] != loop["incumbent_version"]
+    assert loop["candidate"]["loss"] < loop["incumbent"]["loss"]
+    assert loop["gates_reset"] and loop["updater_resumed"]
+    assert loop["post_swap_trips"] == 0
+    assert loop["post_swap_status"] == "ok"
+    assert loop["refit_metrics"]["swaps"] >= 1
+    # zero fresh XLA traces in the serving path on BOTH sides of the swap
+    traces = next(e for e in detail["entries"]
+                  if e["name"] == "refit_traces")
+    assert traces["zero_traces_ok"] is True
+    assert traces["fresh_traces_before_swap"] == 0
+    assert traces["fresh_traces_after_swap"] == 0
+    assert traces["version_after"] != traces["version_before"]
+    # the latency leg's subprocess refit ran cycles and exited cleanly
+    # (the 1.2x ratio is the full bench's hard gate, not smoke's)
+    latency = next(e for e in detail["entries"]
+                   if e["name"] == "refit_latency")
+    assert latency["child_rc"] == 0
+    assert latency["first_cycle_before_measurement"] is True
+    assert latency["refit_cycles"] >= 1 or latency["refit_swap_dirs"] >= 1
+    assert latency["overlapped_reps"] == latency["reps"]
+
+
 def test_fleet_smoke(tmp_path):
     """bench.py --fleet --smoke end-to-end in tier-1 (ISSUE 12
     satellite): the replicated-serving harness — log replay with zero
