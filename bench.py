@@ -1681,6 +1681,194 @@ def stoch_bench(out_path="BENCH_stoch.json", smoke=False, max_wall=None):
 
 
 # --------------------------------------------------------------------------
+# vectorized hyperparameter sweep benchmark (--sweep): K candidates, one
+# compiled program
+# --------------------------------------------------------------------------
+
+def _sweep_game_data(n, d, users, d_user, seed):
+    from photon_ml_tpu.data import build_game_dataset
+    rng = np.random.default_rng(seed)
+    xg = rng.normal(size=(n, d))
+    xg[:, -1] = 1.0
+    xu = rng.normal(size=(n, d_user))
+    u = rng.integers(0, users, size=n)
+    z = xg @ rng.normal(size=d) + np.einsum(
+        "nd,nd->n", xu, rng.normal(size=(users, d_user))[u] * 0.7)
+    y = z + 0.15 * rng.normal(size=n)
+    ds = build_game_dataset(
+        y, {"g": xg, "u": xu},
+        entity_ids={"userId": np.asarray([f"u{i}" for i in u])})
+    rows = np.arange(n)
+    cut = int(n * 0.8)
+    return ds.subset(rows[:cut]), ds.subset(rows[cut:])
+
+
+def _sweep_config(w_fe, w_re, outer):
+    from photon_ml_tpu.game import (FixedEffectCoordinateConfig,
+                                    GameTrainingConfig, GLMOptimizationConfig,
+                                    RandomEffectCoordinateConfig)
+    from photon_ml_tpu.optim import RegularizationContext, RegularizationType
+    l2 = RegularizationContext(RegularizationType.L2)
+    return GameTrainingConfig(
+        "linear_regression",
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                "g", GLMOptimizationConfig(regularization=l2,
+                                           regularization_weight=w_fe)),
+            "perUser": RandomEffectCoordinateConfig(
+                "userId", "u", GLMOptimizationConfig(
+                    regularization=l2, regularization_weight=w_re)),
+        },
+        updating_sequence=["fixed", "perUser"],
+        num_outer_iterations=outer)
+
+
+def _sweep_vmap_leg(n, d, users, d_user, K, outer, seed):
+    """vmap lane: K candidates ride a leading axis through the compiled
+    FE/RE updates, so each coordinate visit is ONE device program against
+    ONE staged copy of the data.  Gates: zero fresh traces across a
+    K-point sweep after warmup (lambda is a traced operand); per-candidate
+    objective parity <= 1e-6 vs isolated f64 fits; sweep wall <= (K/2)x
+    one warm isolated fit."""
+    from photon_ml_tpu.game import GameEstimator
+    from photon_ml_tpu.hyperparameter import SweepEvaluator
+    train, val = _sweep_game_data(n, d, users, d_user, seed)
+    lams = np.logspace(1.5, -2, K)
+    cands = [_sweep_config(lam, 2.0 * lam, outer) for lam in lams]
+    warmups = [_sweep_config(0.7 * lam, 1.3 * lam, outer) for lam in lams]
+    sweep = SweepEvaluator(GameEstimator(_sweep_config(1.0, 1.0, outer)),
+                           train, validation_data=val)
+    eligible, why = sweep.vmap_eligible()
+    if not eligible:
+        raise RuntimeError(f"sweep vmap leg ineligible: {why}")
+    _log(f"sweep[vmap]: warmup {K}-candidate sweep (n={n}, d={d})")
+    sweep.evaluate_vmapped(warmups)
+    with _trace_counting() as tc:
+        t0 = time.perf_counter()
+        results = sweep.evaluate_vmapped(cands)
+        sweep_wall = time.perf_counter() - t0
+    _log(f"sweep[vmap]: {K} candidates in {sweep_wall:.3f}s, "
+         f"{tc.count} fresh traces; running {K} isolated fits")
+    # the pre-sweep cost model: one fresh estimator per candidate (its own
+    # coordinate build + staging pass), compile caches warm
+    GameEstimator(cands[0]).fit(train, validation_dataset=val)
+    iso_walls, iso_objs = [], []
+    for cand in cands:
+        t0 = time.perf_counter()
+        iso = GameEstimator(cand).fit(train, validation_dataset=val)
+        iso_walls.append(time.perf_counter() - t0)
+        iso_objs.append(float(iso.objective_history[-1]))
+    iso_wall = float(np.median(iso_walls))
+    objs = [float(r.objective_history[-1]) for r in results]
+    parity = max(abs(a - b) / max(abs(b), 1e-12)
+                 for a, b in zip(objs, iso_objs))
+    ratio = sweep_wall / max(iso_wall, 1e-9)
+    return {
+        "name": "sweep_vmap", "n": n, "candidates": K,
+        "sweep_wall_s": round(sweep_wall, 4),
+        "isolated_fit_wall_s": round(iso_wall, 4),
+        "wall_ratio_vs_one_fit": round(ratio, 3),
+        "fresh_traces_after_warmup": tc.count,
+        "objective_parity_rel": parity,
+        "traces_ok": tc.count == 0,
+        "parity_ok": parity <= 1e-6,
+        "sublinear_ok": ratio <= K / 2.0,
+        "note": ("the wall gate measures dispatch/staging amortization: a "
+                 "1-core CPU still serializes per-lane FLOPs, so the gate "
+                 "sits where per-fit overhead is a real fraction of the "
+                 "fit — exactly the many-small-refits regime a GP sweep "
+                 "dispatches"),
+    }
+
+
+def _sweep_path_leg(n, d, users, d_user, K, outer, seed):
+    """warm-start path lane (the sequential / out-of-core fallback):
+    candidates run strong-to-weak with each x0 = the neighbor's solution.
+    Gate: after the first candidate compiles, the remaining K-1 re-dispatch
+    the same programs with lambda as a traced operand — zero fresh traces.
+    Warm-start quality is a sanity bound (final objective <= 1.02x the
+    cold-start fit), NOT a parity gate: a different x0 changes the
+    finite-iteration descent trajectory."""
+    from photon_ml_tpu.game import GameEstimator
+    from photon_ml_tpu.hyperparameter import SweepEvaluator
+    train, val = _sweep_game_data(n, d, users, d_user, seed)
+    lams = np.logspace(1.0, -2, K)
+    cands = [_sweep_config(lam, 2.0 * lam, outer) for lam in lams]
+    sweep = SweepEvaluator(GameEstimator(_sweep_config(1.0, 1.0, outer)),
+                           train, validation_data=val)
+    _log(f"sweep[path]: warmup candidate, then {K}-point path (n={n})")
+    sweep.evaluate_path(cands[:1])
+    with _trace_counting() as tc:
+        t0 = time.perf_counter()
+        warm = sweep.evaluate_path(cands)
+        wall = time.perf_counter() - t0
+    cold = sweep.evaluate_path(cands, warm_start=False)
+    quality_ok = all(
+        float(w.objective_history[-1])
+        <= float(c.objective_history[-1]) * 1.02
+        for w, c in zip(warm, cold))
+    return {
+        "name": "sweep_path", "n": n, "candidates": K,
+        "path_wall_s": round(wall, 4),
+        "fresh_traces_after_first_candidate": tc.count,
+        "path_traces_ok": tc.count == 0,
+        "warm_start_quality_ok": quality_ok,
+    }
+
+
+def sweep_bench(out_path="BENCH_sweep.json", smoke=False, max_wall=None):
+    """Vectorized hyperparameter sweeps (ISSUE 17): K candidates, one
+    compiled program.  HARD gates (vmap leg): (1) zero fresh XLA traces
+    across a 16-point sweep after warmup — lambda and the elastic-net mix
+    are traced operands of the compiled solvers; (2) per-candidate
+    objective parity <= 1e-6 vs isolated f64 fits; (3) sublinear
+    wall-clock — 16 candidates <= 8x one warm isolated fit.  The path leg
+    gates zero fresh traces after the first candidate and sanity-bounds
+    warm-start quality."""
+    ndev = _ensure_virtual_devices(8)
+    suite_t0 = time.perf_counter()
+    if smoke:
+        vm = dict(n=1024, d=12, users=40, d_user=4, K=16, outer=2, seed=17)
+        pa = dict(n=512, d=8, users=24, d_user=3, K=6, outer=2, seed=18)
+    else:
+        vm = dict(n=max(int(4096 * _SCALE), 1024), d=24, users=100,
+                  d_user=6, K=16, outer=2, seed=17)
+        pa = dict(n=2048, d=12, users=48, d_user=4, K=12, outer=2, seed=18)
+
+    entries = [_sweep_vmap_leg(**vm)]
+    if max_wall is None or time.perf_counter() - suite_t0 < max_wall:
+        entries.append(_sweep_path_leg(**pa))
+    by_name = {e["name"]: e for e in entries}
+    vm_e = by_name["sweep_vmap"]
+    pa_e = by_name.get("sweep_path")
+    result = {
+        "metric": "sweep_wall_ratio_vs_one_fit",
+        "value": vm_e["wall_ratio_vs_one_fit"],
+        "unit": "x",
+        "detail": {
+            "entries": entries,
+            "traces_ok": vm_e["traces_ok"],
+            "parity_ok": vm_e["parity_ok"],
+            "sublinear_ok": vm_e["sublinear_ok"],
+            "path_traces_ok": (pa_e or {}).get("path_traces_ok"),
+            "all_gates_ok": bool(
+                vm_e["traces_ok"] and vm_e["parity_ok"]
+                and vm_e["sublinear_ok"]
+                and (pa_e or {"path_traces_ok": True})["path_traces_ok"]),
+            "devices": ndev,
+            "smoke": smoke,
+        },
+    }
+    _embed_telemetry(result)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 # inexact coordinate descent benchmark (--inexact): strict vs scheduled
 # --------------------------------------------------------------------------
 
@@ -6207,6 +6395,13 @@ def _dispatch():
         paths = [a for i, a in enumerate(rest) if not a.startswith("--")
                  and (i == 0 or rest[i - 1] != "--max-wall")]
         stoch_bench(*(paths[:1] or ["BENCH_stoch.json"]), smoke=smoke,
+                    max_wall=_parse_max_wall(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--sweep":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        sweep_bench(*(paths[:1] or ["BENCH_sweep.json"]), smoke=smoke,
                     max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--mesh":
         smoke = "--smoke" in sys.argv[2:]

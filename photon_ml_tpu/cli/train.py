@@ -123,6 +123,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tuning-iterations", type=int, default=10)
     p.add_argument("--tuning-range", default="-3,3",
                    help="log10 lambda search range 'lo,hi' per coordinate")
+    p.add_argument("--sweep-seed", type=int, default=None,
+                   help="seed for the hyperparameter search (candidate "
+                        "draws + GP slice sampler): a fixed seed reproduces "
+                        "the candidate sequence bit-identically; default = "
+                        "the training config's seed")
     p.add_argument("--warm-start", action="store_true",
                    help="initialize each grid combo / tuning refit from the "
                         "previous (best) model (reference: use-warm-start, "
@@ -664,11 +669,17 @@ def _run(args, log) -> int:
             lo, hi = (float(v) for v in args.tuning_range.split(","))
             ranges = [(lo, hi)] * fn.num_params
             spec0 = results[0].validation_specs[0]
+            # --sweep-seed pins the WHOLE search chain (candidate draws,
+            # GP estimator init, slice sampler) independently of the
+            # training seed: a fixed value reproduces the candidate
+            # sequence bit-identically
+            sweep_seed = (args.sweep_seed if args.sweep_seed is not None
+                          else config.seed)
             if args.tuning == "bayesian":
                 search = GaussianProcessSearch(ranges, fn, spec0.evaluator,
-                                               seed=config.seed)
+                                               seed=sweep_seed)
             else:
-                search = RandomSearch(ranges, fn, seed=config.seed)
+                search = RandomSearch(ranges, fn, seed=sweep_seed)
             prior = [r for r in results if r.validation]
             results = results + search.find(args.tuning_iterations, prior)
 

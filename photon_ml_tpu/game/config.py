@@ -39,6 +39,12 @@ class GLMOptimizationConfig:
     def __post_init__(self):
         if self.regularization_weight < 0:
             raise ValueError("regularization_weight must be >= 0")
+        # normalize to a python float: a np.float64 weight is a STRONG-typed
+        # jax scalar while a python float is weak-typed, and that weakness
+        # difference is a fresh trace-cache key for every compiled program
+        # lambda rides into — a sweep mixing the two would silently retrace
+        object.__setattr__(self, "regularization_weight",
+                           float(self.regularization_weight))
         if self.downsampling_rate is not None and not 0 < self.downsampling_rate < 1:
             raise ValueError("downsampling_rate must be in (0, 1)")
 

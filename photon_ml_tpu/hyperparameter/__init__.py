@@ -10,3 +10,4 @@ from photon_ml_tpu.hyperparameter.search import (  # noqa: F401
 from photon_ml_tpu.hyperparameter.game_evaluation import (  # noqa: F401
     GameEstimatorEvaluationFunction,
 )
+from photon_ml_tpu.hyperparameter.vectorized import SweepEvaluator  # noqa: F401

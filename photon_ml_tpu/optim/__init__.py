@@ -3,7 +3,8 @@ from photon_ml_tpu.optim.config import (  # noqa: F401
 )
 from photon_ml_tpu.optim.lbfgs import lbfgs, owlqn  # noqa: F401
 from photon_ml_tpu.optim.schedule import (  # noqa: F401
-    QuarantineRetrySchedule, SolveBudget, SolverSchedule, StochasticPlan,
+    QuarantineRetrySchedule, RegWeights, SolveBudget, SolverSchedule,
+    StochasticPlan,
 )
 from photon_ml_tpu.optim.stochastic import solve_stochastic  # noqa: F401
 from photon_ml_tpu.optim.streaming import (  # noqa: F401

@@ -169,13 +169,26 @@ def solve(
     tests the dynamic cap, and a per-outer-iteration budget schedule
     compiles nothing new.  `budget=None` keeps the config's static values,
     which is the identical arithmetic.
+
+    `reg_weight` may be an optim.schedule.RegWeights: then BOTH penalty
+    weights ride as traced operands (bypassing `reg.split`'s static
+    arithmetic), so a hyperparameter sweep over lambda — or the elastic-net
+    mix — re-dispatches one compiled program.  `reg.has_l1` remains the
+    static structural flag either way: it decides whether the L1 machinery
+    is compiled in at all; a traced l1 of 0 under `has_l1=True` converges
+    to the same smooth optimum (to solver tolerance — OWLQN's orthant
+    projection stays compiled in and can clip steps mid-path).
     """
     cfg = config.resolved()
     if cfg.constraints is not None:
         raise ValueError(
             "named feature constraints are unresolved — call "
             "config.resolved_constraints(index_map) before solve()")
-    l1_w, l2_w = reg.split(reg_weight)
+    from photon_ml_tpu.optim.schedule import RegWeights
+    if isinstance(reg_weight, RegWeights):
+        l1_w, l2_w = reg_weight.l1_weight, reg_weight.l2_weight
+    else:
+        l1_w, l2_w = reg.split(reg_weight)
     obj = objective.with_l2(l2_w)
     tolerance = cfg.tolerance if budget is None else budget.tolerance
     iteration_cap = None if budget is None else budget.iteration_cap

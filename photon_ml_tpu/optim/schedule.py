@@ -84,6 +84,47 @@ class SolveBudget(NamedTuple):
                            jnp.asarray(float(tolerance)))
 
 
+class RegWeights(NamedTuple):
+    """Traced regularization operands — the SolveBudget trick applied to
+    lambda itself.  A compiled solve that takes a RegWeights instead of a
+    scalar reg_weight receives BOTH penalty weights as program operands, so
+    sweeping the total weight OR the elastic-net mixing ratio re-dispatches
+    the same executable: changing lambda never retraces, and a candidate
+    axis can vmap straight over it.
+
+    The STRUCTURAL choice stays static: `RegularizationContext.has_l1`
+    decides at trace time whether the OWLQN pseudo-gradient machinery is
+    compiled in.  A RegWeights with nonzero l1_weight handed to a solve
+    whose context has `has_l1 == False` is silently ignored — elastic-net
+    sweeps that vary the mix must trace against a context with
+    `has_l1 == True` (traced l1 == 0 makes OWLQN's pseudo-gradient equal
+    the plain gradient, so it converges to the SAME smooth optimum — the
+    orthant projection can still clip sign-flipping steps mid-path, so
+    iterates match plain LBFGS to solver tolerance, not bit-for-bit)."""
+
+    l1_weight: jax.Array        # float scalar (or [K] under vmap)
+    l2_weight: jax.Array        # float scalar (or [K] under vmap)
+
+    @staticmethod
+    def make(l1_weight, l2_weight, dtype=None) -> "RegWeights":
+        return RegWeights(jnp.asarray(l1_weight, dtype),
+                          jnp.asarray(l2_weight, dtype))
+
+    @staticmethod
+    def from_context(reg, reg_weight, elastic_net_alpha=None,
+                     dtype=None) -> "RegWeights":
+        """Split a total weight exactly as `reg.split` would, but with the
+        mixing ratio optionally TRACED: `elastic_net_alpha=None` reproduces
+        the context's own (static) split arithmetic; passing an alpha makes
+        the mix a traced operand (`l1 = a*w`, `l2 = (1-a)*w`)."""
+        w = jnp.asarray(reg_weight, dtype)
+        if elastic_net_alpha is None:
+            l1, l2 = reg.split(w)
+            return RegWeights(jnp.asarray(l1, dtype), jnp.asarray(l2, dtype))
+        a = jnp.asarray(elastic_net_alpha, w.dtype)
+        return RegWeights(a * w, (1.0 - a) * w)
+
+
 @dataclasses.dataclass(frozen=True)
 class SolverSchedule:
     """Per-(outer-iteration) inexactness schedule for the inner solvers.

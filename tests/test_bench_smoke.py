@@ -74,6 +74,42 @@ def test_stoch_smoke(tmp_path):
         assert detail["mesh_parity_ok"] is True
 
 
+def test_sweep_smoke(tmp_path):
+    """bench.py --sweep --smoke end-to-end in tier-1 (ISSUE 17 satellite):
+    the vectorized-sweep gates — zero fresh XLA traces across a 16-point
+    sweep after warmup (lambda is a traced operand of the compiled
+    solvers), per-candidate objective parity <= 1e-6 vs isolated f64
+    fits, sublinear sweep wall-clock, and zero fresh traces along the
+    warm-start path after the first candidate — run on every tier-1 pass,
+    so the sweep lane cannot silently regress into per-lambda retracing
+    or diverge from the isolated-fit arithmetic."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_sweep.json"
+    result = bench.sweep_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_gates_ok"] is True
+    assert detail["traces_ok"] and detail["parity_ok"]
+    assert detail["sublinear_ok"] and detail["path_traces_ok"]
+    vm = next(e for e in detail["entries"] if e["name"] == "sweep_vmap")
+    assert vm["candidates"] == 16
+    assert vm["fresh_traces_after_warmup"] == 0
+    assert vm["objective_parity_rel"] <= 1e-6
+    assert vm["wall_ratio_vs_one_fit"] <= vm["candidates"] / 2.0
+    pa = next(e for e in detail["entries"] if e["name"] == "sweep_path")
+    assert pa["fresh_traces_after_first_candidate"] == 0
+    assert pa["warm_start_quality_ok"] is True
+    # the sweep counters rode into the embedded telemetry snapshot
+    counters = detail["telemetry"]["metrics"]["counters"]
+    assert counters["sweep.candidates"] >= 2 * vm["candidates"]
+    assert counters["sweep.dispatches"] > 0
+
+
 def test_stream_smoke(tmp_path):
     """bench.py --stream --smoke end-to-end in tier-1 (ISSUE 3 satellite):
     the out-of-core harness — ChunkedGLMObjective streaming, HBM-budgeted

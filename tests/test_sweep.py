@@ -1,0 +1,369 @@
+"""Vectorized hyperparameter sweeps (hyperparameter/vectorized.py).
+
+The contract under test: K regularization candidates cost one compiled
+program (vmap lane) or one warm-started regularization path (sequential
+lane), never K cold fits — regularization weights are TRACED OPERANDS
+(optim.schedule.RegWeights), so changing lambda never retraces; per-candidate
+results match isolated full f64 fits; the GP search chain is bit-identical
+under a fixed seed.
+"""
+import dataclasses
+import logging
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import build_game_dataset
+from photon_ml_tpu.game import (
+    FixedEffectCoordinateConfig, GameEstimator, GameTrainingConfig,
+    GLMOptimizationConfig, RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.hyperparameter import (
+    GameEstimatorEvaluationFunction, SweepEvaluator,
+)
+from photon_ml_tpu.optim import (
+    OptimizerConfig, RegularizationContext, RegularizationType, RegWeights,
+    solve,
+)
+
+L2 = RegularizationContext(RegularizationType.L2)
+EN = RegularizationContext(RegularizationType.ELASTIC_NET,
+                           elastic_net_alpha=0.5)
+
+
+class _CompileCounter(logging.Handler):
+    """Counts XLA compile events via jax_log_compiles (each 'Compiling
+    <name> with global shapes' record is one fresh trace+compile)."""
+
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def emit(self, record):
+        if record.getMessage().startswith("Compiling "):
+            self.count += 1
+
+
+class _compile_counting:
+    def __enter__(self):
+        import jax
+        self._jax = jax
+        self.handler = _CompileCounter()
+        self.logger = logging.getLogger("jax._src.interpreters.pxla")
+        self._level = self.logger.level
+        self.logger.addHandler(self.handler)
+        self.logger.setLevel(logging.WARNING)
+        jax.config.update("jax_log_compiles", True)
+        return self.handler
+
+    def __exit__(self, *exc):
+        self._jax.config.update("jax_log_compiles", False)
+        self.logger.removeHandler(self.handler)
+        self.logger.setLevel(self._level)
+
+
+def _game_data(rng, n=400, d=5, users=12):
+    xg = rng.normal(size=(n, d))
+    xg[:, -1] = 1.0
+    xu = rng.normal(size=(n, 3))
+    u = rng.integers(0, users, size=n)
+    z = xg @ rng.normal(size=d) + np.einsum(
+        "nd,nd->n", xu, rng.normal(size=(users, 3))[u] * 0.7)
+    y = z + 0.15 * rng.normal(size=n)
+    ds = build_game_dataset(
+        y, {"g": xg, "u": xu},
+        entity_ids={"userId": np.asarray([f"u{i}" for i in u])})
+    rows = np.arange(n)
+    return ds.subset(rows[:300]), ds.subset(rows[300:])
+
+
+def _config(w_fe=1.0, w_re=1.0, iters=2):
+    return GameTrainingConfig(
+        "linear_regression",
+        coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                "g", GLMOptimizationConfig(regularization=L2,
+                                           regularization_weight=w_fe)),
+            "perUser": RandomEffectCoordinateConfig(
+                "userId", "u", GLMOptimizationConfig(
+                    regularization=L2, regularization_weight=w_re)),
+        },
+        updating_sequence=["fixed", "perUser"], num_outer_iterations=iters)
+
+
+# -- RegWeights: lambda as a traced operand -----------------------------------
+
+def test_regweights_matches_static_split(rng):
+    """solve() with RegWeights reproduces the static reg.split arithmetic
+    bit-for-bit — same objective, same solution."""
+    from tests.synthetic import make_glm_data
+    from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+    x, y, _, _ = make_glm_data(rng, n=120, d=6, task="logistic")
+    obj = GLMObjective(TASK_LOSSES["logistic_regression"], x, y)
+    x0 = np.zeros(6)
+    for reg, w in ((L2, 0.7), (EN, 0.3)):
+        static = solve(obj, x0, OptimizerConfig(), reg, w)
+        traced = solve(obj, x0, OptimizerConfig(), reg,
+                       RegWeights.from_context(reg, w))
+        np.testing.assert_array_equal(np.asarray(static.x),
+                                      np.asarray(traced.x))
+
+
+def test_regweights_elastic_net_mix_is_traced(rng):
+    """Varying the elastic-net MIX via RegWeights.from_context(alpha)
+    re-dispatches one compiled program: zero fresh traces after warmup."""
+    import jax
+    from tests.synthetic import make_glm_data
+    from photon_ml_tpu.ops import TASK_LOSSES, GLMObjective
+    x, y, _, _ = make_glm_data(rng, n=120, d=6, task="logistic")
+    obj = GLMObjective(TASK_LOSSES["logistic_regression"], x, y)
+    x0 = np.zeros(6)
+
+    solver = jax.jit(lambda o, x0, rw: solve(x0=x0, objective=o,
+                                             config=OptimizerConfig(),
+                                             reg=EN, reg_weight=rw))
+    sols = []
+    rws = [RegWeights.from_context(EN, w, elastic_net_alpha=a)
+           for w, a in ((1.0, 0.5), (0.1, 0.9), (3.0, 0.1), (1.0, 0.0))]
+    solver(obj, x0, rws[0])  # warmup trace
+    with _compile_counting() as compiles:
+        for rw in rws[1:]:
+            sols.append(np.asarray(solver(obj, x0, rw).x))
+    assert compiles.count == 0, (
+        f"{compiles.count} fresh traces while sweeping (lambda, alpha) — "
+        "regularization weights must be traced operands")
+    # the solutions genuinely differ (the sweep is not a no-op)
+    assert not np.allclose(sols[0], sols[1])
+    # traced alpha == 0 under has_l1=True reaches the same smooth optimum
+    # as the pure-L2 solve (same limit; iterates differ mid-path because
+    # OWLQN's orthant projection stays compiled in)
+    pure_l2 = solve(obj, x0, OptimizerConfig(), L2, 1.0)
+    np.testing.assert_allclose(sols[2], np.asarray(pure_l2.x), atol=1e-4)
+
+
+# -- vmap lane ----------------------------------------------------------------
+
+def test_vmapped_sweep_parity_vs_isolated_fits(rng):
+    """Per-candidate f64 parity <= 1e-6: every candidate of the vmapped
+    sweep matches its isolated full fit — objective trajectory, final
+    coefficients, and validation metric."""
+    train, val = _game_data(rng)
+    candidates = [_config(10.0, 5.0), _config(1.0, 1.0), _config(0.1, 0.3)]
+    sweep = SweepEvaluator(GameEstimator(_config()), train, val)
+    ok, why = sweep.vmap_eligible()
+    assert ok, why
+    results = sweep.evaluate_vmapped(candidates)
+    assert len(results) == 3
+    for cand, res in zip(candidates, results):
+        iso = GameEstimator(cand).fit(train, val)
+        np.testing.assert_allclose(res.objective_history,
+                                   iso.objective_history, rtol=1e-6)
+        np.testing.assert_allclose(res.validation["RMSE"],
+                                   iso.validation["RMSE"], rtol=1e-6)
+        for name in ("fixed", "perUser"):
+            a = res.model.coordinates[name]
+            b = iso.model.coordinates[name]
+            va = (a.glm.coefficients.means if name == "fixed"
+                  else a.coefficients)
+            vb = (b.glm.coefficients.means if name == "fixed"
+                  else b.coefficients)
+            np.testing.assert_allclose(np.asarray(va), np.asarray(vb),
+                                       atol=1e-6)
+        # per-candidate diagnostics flow through solver_diagnostics()
+        diag = res.descent.solver_diagnostics()
+        assert diag["fixed"]["solves"] == 2
+        assert diag["fixed"]["iterations"] > 0
+        assert diag["perUser"]["reasons"]
+
+
+def test_vmapped_sweep_ineligible_shapes_are_refused(rng):
+    """Streamed coordinates fall off the vmap lane with a reason (the
+    caller then routes to the warm-start path)."""
+    train, val = _game_data(rng)
+    cfg = _config()
+    streamed = dataclasses.replace(cfg, coordinates={
+        **cfg.coordinates,
+        "fixed": dataclasses.replace(cfg.coordinates["fixed"],
+                                     memory_mode="streamed",
+                                     chunk_rows=128)})
+    sweep = SweepEvaluator(GameEstimator(streamed), train, val)
+    ok, why = sweep.vmap_eligible()
+    assert not ok and "streamed" in why
+    with pytest.raises(ValueError, match="vmap lane ineligible"):
+        sweep.evaluate_vmapped([_config(), _config(0.1, 0.1)])
+    # evaluate() falls back instead of raising
+    results = sweep.evaluate([streamed, dataclasses.replace(
+        streamed, coordinates={**streamed.coordinates,
+                               "perUser": dataclasses.replace(
+                                   streamed.coordinates["perUser"],
+                                   optimization=GLMOptimizationConfig(
+                                       regularization=L2,
+                                       regularization_weight=0.1))})])
+    assert len(results) == 2 and all(r.validation for r in results)
+
+
+def test_sweep_rejects_structural_config_changes(rng):
+    """Only regularization weights may vary across candidates — anything
+    else must not silently reuse the prepared state."""
+    train, val = _game_data(rng)
+    sweep = SweepEvaluator(GameEstimator(_config()), train, val)
+    structural = _config(iters=3)          # different outer iterations
+    assert not sweep.compatible(structural)
+    with pytest.raises(ValueError, match="more than regularization"):
+        sweep.evaluate_config(structural)
+
+
+# -- warm-start path lane -----------------------------------------------------
+
+def test_path_sweep_cold_parity_and_warm_ordering(rng):
+    """The sequential lane with warm_start=False IS the isolated fit (same
+    solvers over the shared prepared coordinates); with warm starts the
+    path runs strong-to-weak and each candidate still converges (objective
+    within the solver's tolerance band of the cold fit)."""
+    train, val = _game_data(rng)
+    candidates = [_config(0.1, 0.3), _config(10.0, 5.0), _config(1.0, 1.0)]
+    sweep = SweepEvaluator(GameEstimator(_config()), train, val)
+    cold = sweep.evaluate_path(candidates, warm_start=False)
+    for cand, res in zip(candidates, cold):
+        iso = GameEstimator(cand).fit(train, val)
+        np.testing.assert_allclose(res.objective_history,
+                                   iso.objective_history, rtol=1e-6)
+        np.testing.assert_allclose(res.validation["RMSE"],
+                                   iso.validation["RMSE"], rtol=1e-6)
+    warm = sweep.evaluate_path(candidates, warm_start=True)
+    # results come back in CALLER order regardless of path order
+    for cand, res in zip(candidates, warm):
+        assert res.config is cand
+    # a warm-started solve still reaches (or beats) the neighborhood of
+    # the cold solution — the path changes trajectories, not the limit
+    for c, w in zip(cold, warm):
+        assert w.objective_history[-1] <= c.objective_history[-1] * 1.02
+
+
+def test_path_sweep_zero_fresh_traces_after_first_candidate(rng):
+    """Candidates 2..N of the path lane re-dispatch the first candidate's
+    compiled programs — lambda is a traced operand everywhere."""
+    train, val = _game_data(rng)
+    sweep = SweepEvaluator(GameEstimator(_config()), train, val)
+    sweep.evaluate_config(_config(5.0, 2.0))   # warmup: compiles everything
+    lams = np.logspace(1, -2, 15)
+    with _compile_counting() as compiles:
+        sweep.evaluate_path([_config(l, l) for l in lams])
+    assert compiles.count == 0, (
+        f"{compiles.count} fresh traces across a 15-candidate path sweep")
+
+
+def test_vmapped_sweep_zero_fresh_traces_after_warmup(rng):
+    """The 16-point compile-count regression: after one warmup sweep of
+    the same candidate count, a full 16-point vmapped sweep triggers ZERO
+    fresh XLA traces."""
+    train, val = _game_data(rng)
+    sweep = SweepEvaluator(GameEstimator(_config()), train, val)
+    lams = np.logspace(1.5, -2, 16)
+    sweep.evaluate_vmapped([_config(l, 2 * l) for l in lams])   # warmup
+    with _compile_counting() as compiles:
+        results = sweep.evaluate_vmapped(
+            [_config(0.7 * l, l) for l in lams])
+    assert compiles.count == 0, (
+        f"{compiles.count} fresh traces across a warm 16-point sweep")
+    assert len(results) == 16
+    objs = [r.objective_history[-1] for r in results]
+    assert len(set(round(o, 6) for o in objs)) > 1
+
+
+# -- shared prepared state + GP integration -----------------------------------
+
+def test_evaluation_function_shares_prepared_state(rng, monkeypatch):
+    """GameEstimatorEvaluationFunction builds the GAME dataset/coordinate
+    state ONCE: repeated candidate evaluations hit the same
+    SweepEvaluator, never a per-candidate rebuild."""
+    train, val = _game_data(rng)
+    est = GameEstimator(_config())
+    builds = []
+    orig = GameEstimator._build_coordinates
+
+    def counting(self, dataset):
+        builds.append(dataset)
+        return orig(self, dataset)
+
+    monkeypatch.setattr(GameEstimator, "_build_coordinates", counting)
+    fn = GameEstimatorEvaluationFunction(est, train, val, scale="log")
+    v1, r1 = fn(np.asarray([0.5, 0.5]))
+    v2, r2 = fn(np.asarray([-0.5, 0.0]))
+    assert len(builds) == 1, (
+        f"{len(builds)} coordinate builds for 2 candidate evaluations — "
+        "the prepared dataset must be shared")
+    assert fn.sweep is fn.sweep
+    assert r1.config is not r2.config and v1 != v2
+
+    batch = fn.evaluate_all([np.asarray([1.0, 1.0]),
+                             np.asarray([0.0, -1.0])])
+    assert len(builds) == 1 and len(batch) == 2
+
+
+def test_sweep_telemetry_counters(rng):
+    """sweep.candidates / sweep.dispatches land on the global registry;
+    the vmap lane's dispatch count is SUBLINEAR in candidates (that is
+    the point)."""
+    from photon_ml_tpu import telemetry
+    train, val = _game_data(rng)
+    sweep = SweepEvaluator(GameEstimator(_config()), train, val)
+    c0 = telemetry.counter("sweep.candidates").value
+    d0 = telemetry.counter("sweep.dispatches").value
+    K = 8
+    sweep.evaluate_vmapped([_config(l, l) for l in np.logspace(1, -2, K)])
+    candidates = telemetry.counter("sweep.candidates").value - c0
+    dispatches = telemetry.counter("sweep.dispatches").value - d0
+    assert candidates == K
+    # 2 outer iters x (1 FE + <=bucket+1 RE) programs + validation: far
+    # fewer dispatches than K isolated fits would have issued
+    assert 0 < dispatches <= 16
+    snap = telemetry.snapshot()
+    assert "sweep.candidates" in snap["metrics"]["counters"]
+    assert "sweep.dispatches" in snap["metrics"]["counters"]
+
+
+def test_gp_sweep_seed_reproduces_candidate_sequence():
+    """Fixed seed -> bit-identical candidate sequences through the full GP
+    chain (candidate init + GP estimator + slice sampler)."""
+    from photon_ml_tpu.evaluation.evaluators import RMSE
+    from photon_ml_tpu.hyperparameter import GaussianProcessSearch
+    from photon_ml_tpu.hyperparameter.search import EvaluationFunction
+
+    class Quad(EvaluationFunction[tuple]):
+        def __init__(self):
+            self.seen = []
+
+        def __call__(self, candidate):
+            v = float(np.sum((np.asarray(candidate) - 0.3) ** 2))
+            obs = (np.asarray(candidate, dtype=float).copy(), v)
+            self.seen.append(obs[0])
+            return v, obs
+
+        def vectorize_params(self, observation):
+            return observation[0]
+
+        def get_evaluation_value(self, observation):
+            return observation[1]
+
+    def run(seed):
+        fn = Quad()
+        GaussianProcessSearch([(-2.0, 2.0)] * 2, fn, RMSE,
+                              candidate_pool_size=40, seed=seed).find(6)
+        return np.asarray(fn.seen)
+
+    a, b, c = run(11), run(11), run(12)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_cli_exposes_sweep_seed():
+    from photon_ml_tpu.cli.train import build_parser
+    args = build_parser().parse_args(
+        ["--train-data", "x.avro", "--task", "logistic_regression",
+         "--output-dir", "/tmp/o", "--sweep-seed", "123"])
+    assert args.sweep_seed == 123
+    args = build_parser().parse_args(
+        ["--train-data", "x.avro", "--task", "logistic_regression",
+         "--output-dir", "/tmp/o"])
+    assert args.sweep_seed is None
