@@ -1681,6 +1681,301 @@ def stoch_bench(out_path="BENCH_stoch.json", smoke=False, max_wall=None):
 
 
 # --------------------------------------------------------------------------
+# feature-axis consensus-ADMM benchmark (--admm): transpose-reduction
+# solve over the mesh's feature axis
+# --------------------------------------------------------------------------
+
+def _admm_problem(n, d, loss_name, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    x[:, -1] = 1.0
+    w = rng.normal(size=d) * 0.5
+    z = x @ w
+    if loss_name == "logistic":
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(float)
+    else:
+        y = z + 0.1 * rng.normal(size=n)
+    return x, y
+
+
+def _admm_submesh(num_data, num_feature):
+    import jax
+    from photon_ml_tpu.parallel import make_mesh
+    return make_mesh(num_data, num_feature,
+                     devices=jax.devices()[:num_data * num_feature])
+
+
+def _admm_parity_leg(n, d, max_iterations, seed):
+    """f64 parity of the PURE consensus solve (polish off) against the
+    monolithic host-stepped LBFGS, across mesh shapes 1x1 / 1x2 / 2x2 /
+    4x2 and both curvatures.  HARD gate: penalized-objective rel gap
+    <= 1e-6 on every cell."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.ops.losses import LOGISTIC, SQUARED
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim import (ADMMConfig, OptimizerConfig,
+                                     RegularizationContext,
+                                     RegularizationType)
+    from photon_ml_tpu.parallel.fixed_effect import (fit_fixed_effect,
+                                                     fit_fixed_effect_admm)
+    l2 = RegularizationContext(RegularizationType.L2)
+    cells = []
+    for loss_name, loss in (("logistic", LOGISTIC), ("squared", SQUARED)):
+        x, y = _admm_problem(n, d, loss_name, seed)
+        obj = GLMObjective(loss, x, y)
+        value = lambda c: (float(obj.value(jnp.asarray(c)))
+                           + 0.15 * float(np.asarray(c) @ np.asarray(c)))
+        ref = fit_fixed_effect(
+            obj, np.zeros(d), _admm_submesh(8, 1),
+            OptimizerConfig(max_iterations=500, tolerance=1e-12),
+            reg=l2, reg_weight=0.3)
+        v_ref = value(ref.x)
+        for shape in ((1, 1), (1, 2), (2, 2), (4, 2)):
+            _log(f"admm[parity]: {loss_name} mesh "
+                 f"{shape[0]}x{shape[1]} (n={n}, d={d})")
+            t0 = time.perf_counter()
+            res = fit_fixed_effect_admm(
+                obj, np.zeros(d), _admm_submesh(*shape),
+                ADMMConfig(max_iterations=max_iterations, tolerance=1e-10,
+                           polish=False),
+                reg=l2, reg_weight=0.3,
+                residency_key=("bench-admm-parity", loss_name, shape))
+            gap = abs(value(res.x) - v_ref) / max(abs(v_ref), 1e-12)
+            cells.append({
+                "loss": loss_name, "mesh": f"{shape[0]}x{shape[1]}",
+                "admm_iterations": int(res.iterations),
+                "fit_s": round(time.perf_counter() - t0, 3),
+                "rel_gap": gap, "parity_ok": bool(gap <= 1e-6),
+            })
+    return {
+        "name": "admm_parity", "n": n, "d": d,
+        "max_iterations": max_iterations,
+        "cells": cells,
+        "worst_rel_gap": max(c["rel_gap"] for c in cells),
+        "parity_gate": 1e-6,
+        "parity_ok": bool(all(c["parity_ok"] for c in cells)),
+    }
+
+
+def _admm_memory_leg(n, d, widths, iters, seed):
+    """Per-device aggregator memory vs feature-axis width: the transpose-
+    reduction eigenbasis is [F, d_F, d_F] sharded over "feature", so
+    per-device bytes fall ~quadratically in F (>= the near-LINEAR gate).
+    The budget sub-gate is the wide-model story: a d whose monolithic
+    d^2 aggregator busts a per-device budget trains under a data x
+    feature mesh with every per-device aggregate inside it."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.ops.losses import SQUARED
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim import (ADMMConfig, RegularizationContext,
+                                     RegularizationType)
+    from photon_ml_tpu.parallel.fixed_effect import fit_fixed_effect_admm
+    l2 = RegularizationContext(RegularizationType.L2)
+    x, y = _admm_problem(n, d, "squared", seed)
+    obj = GLMObjective(SQUARED, x, y)
+    v0 = float(obj.value(jnp.zeros(d)))
+    # per-device budget sized so the F=1 (monolithic-layout) aggregator
+    # busts it and the widest mesh fits with room
+    budget = d * d * 8 // 4
+    entries = {}
+    for f_axis in widths:
+        key = ("bench-admm-mem", f_axis)
+        mesh = _admm_submesh(8 // f_axis, f_axis)
+        _log(f"admm[memory]: d={d} feature axis {f_axis} "
+             f"(mesh {8 // f_axis}x{f_axis})")
+        t0 = time.perf_counter()
+        res = fit_fixed_effect_admm(
+            obj, np.zeros(d), mesh,
+            ADMMConfig(max_iterations=iters, tolerance=1e-9, polish=False),
+            reg=l2, reg_weight=0.3, residency_key=key)
+        wall = time.perf_counter() - t0
+        # read the staged aggregates back out of the residency layer via
+        # a second stage call (memoized: returns the pinned arrays)
+        from photon_ml_tpu.parallel.fixed_effect import _stage_admm_operands
+        staged, _, _, _ = _stage_admm_operands(obj, mesh, key)
+        agg_dev = max(s.data.nbytes
+                      for s in staged["q_eig"].addressable_shards)
+        grid_dev = max(s.data.nbytes
+                       for s in staged["x_grid"].addressable_shards)
+        entries[f_axis] = {
+            "mesh": f"{8 // f_axis}x{f_axis}",
+            "per_device_aggregator_bytes": int(agg_dev),
+            "per_device_design_bytes": int(grid_dev),
+            "fit_s": round(wall, 3),
+            "final_value": float(res.value),
+            "objective_decreased": bool(float(res.value) < v0),
+        }
+    base = entries[widths[0]]["per_device_aggregator_bytes"]
+    widest = widths[-1]
+    near_linear_ok = all(
+        entries[f]["per_device_aggregator_bytes"] <= (base / f) * 1.15
+        for f in widths[1:])
+    wide = entries[widest]
+    return {
+        "name": "admm_memory", "n": n, "d": d,
+        "feature_widths": list(widths),
+        "per_device_budget_bytes": int(budget),
+        "entries": {str(k): v for k, v in entries.items()},
+        "reduction_x": round(
+            base / max(wide["per_device_aggregator_bytes"], 1), 2),
+        "near_linear_ok": bool(near_linear_ok),
+        "monolithic_busts_budget": bool(base > budget),
+        "wide_fits_budget": bool(
+            wide["per_device_aggregator_bytes"] <= budget),
+        "wide_trains": bool(wide["objective_decreased"]),
+        "memory_ok": bool(near_linear_ok and base > budget
+                          and wide["per_device_aggregator_bytes"] <= budget
+                          and wide["objective_decreased"]),
+    }
+
+
+def _admm_trace_leg(n, d, seed):
+    """Zero fresh XLA traces across warm consensus solves: rho sweeps,
+    tolerance/budget changes, warm starts and in-loop adaptive rho all
+    re-dispatch the one compiled while_loop."""
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim import (ADMMConfig, RegularizationContext,
+                                     RegularizationType)
+    from photon_ml_tpu.parallel.fixed_effect import fit_fixed_effect_admm
+    l2 = RegularizationContext(RegularizationType.L2)
+    x, y = _admm_problem(n, d, "logistic", seed)
+    obj = GLMObjective(LOGISTIC, x, y)
+    mesh = _admm_submesh(2, 2)
+
+    def run(cfg, x0):
+        return fit_fixed_effect_admm(obj, x0, mesh, cfg, reg=l2,
+                                     reg_weight=0.3,
+                                     residency_key=("bench-admm-trace",))
+
+    base = dict(max_iterations=120, polish=False)
+    first = run(ADMMConfig(tolerance=1e-8, **base), np.zeros(d))
+    run(ADMMConfig(tolerance=1e-8, **base), first.x)  # warm device x0 path
+    sweeps = [(0.25, 1e-6), (1.0, 1e-8), (4.0, 1e-10)]
+    with _trace_counting() as counter:
+        warm = run(ADMMConfig(tolerance=1e-8, **base), np.zeros(d))
+        for rho, tol in sweeps:
+            run(ADMMConfig(rho=rho, tolerance=tol, **base), warm.x)
+    return {
+        "name": "admm_warm_traces",
+        "warm_solves": 1 + len(sweeps),
+        "rho_sweep": [s[0] for s in sweeps],
+        "fresh_traces": counter.count,
+        "traces_ok": bool(counter.count == 0),
+    }
+
+
+def _admm_collective_leg(n, d, seed):
+    """Byte/collective accounting on the compiled iteration body: lower
+    the exact while_loop step with production shardings on a 2x4 mesh and
+    classify every all-reduce in the HLO against the device grid.  HARD
+    gate: exactly ONE [n_local] vector all-reduce over the FEATURE groups
+    and one [F_local, d_F] block all-reduce over DATA per iteration —
+    everything else is scalar residual bookkeeping."""
+    import jax
+    import jax.numpy as jnp
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.admm import (ADMMOperands, cached_step_probe,
+                                          collective_summary, make_init)
+    from photon_ml_tpu.parallel.fixed_effect import _stage_admm_operands
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, feature_sharding
+    x, y = _admm_problem(n, d, "logistic", seed)
+    obj = GLMObjective(LOGISTIC, x, y)
+    mesh = _admm_submesh(2, 4)
+    staged, _, _, bw = _stage_admm_operands(obj, mesh, ("bench-admm-hlo",))
+    dtype = staged["x_grid"].dtype
+    ops = ADMMOperands(
+        x_grid=staged["x_grid"], q_eig=staged["q_eig"],
+        lam_eig=staged["lam_eig"], labels=staged["labels"],
+        kappa=staged["mask"], offsets=staged["offsets"],
+        l1_weight=jnp.asarray(0.0, dtype), l2_weight=jnp.asarray(0.3, dtype))
+    with mesh:
+        w0 = jax.device_put(jnp.zeros((4, bw), dtype),
+                            feature_sharding(mesh, 2))
+        carry = make_init(obj.loss, False, ops, w0,
+                          jnp.asarray(1.0, dtype), 8)
+        txt = cached_step_probe(obj.loss, False, True, 8).lower(
+            ops, carry).compile().as_text()
+    summary = collective_summary(txt, mesh)
+    n_local = staged["labels"].shape[0] // mesh.shape[DATA_AXIS]
+    feat_vec = [e for e in summary["feature"] if e[0] >= 1]
+    data_blk = [e for e in summary["data"] if e[0] >= 1]
+    scalars = sum(1 for lane in summary.values()
+                  for e in lane if e[0] == 0)
+    ok = (feat_vec == [(1, n_local * dtype.itemsize)]
+          and len(data_blk) == 1 and data_blk[0][0] >= 2
+          and not summary["other"]
+          and all(e[0] == 0 for e in summary["global"]))
+    return {
+        "name": "admm_collectives", "n": n, "d": d, "mesh": "2x4",
+        "feature_vector_allreduces": len(feat_vec),
+        "feature_vector_bytes": int(feat_vec[0][1]) if feat_vec else 0,
+        "data_block_allreduces": len(data_blk),
+        "data_block_bytes": int(data_blk[0][1]) if data_blk else 0,
+        "scalar_allreduces": scalars,
+        "collectives_ok": bool(ok),
+    }
+
+
+def admm_bench(out_path="BENCH_admm.json", smoke=False, max_wall=None):
+    """Feature-axis consensus-ADMM lane (optim/admm.py).  HARD gates:
+    (1) f64 parity <= 1e-6 of the pure consensus solve vs the monolithic
+    LBFGS on 1x1 / 1x2 / 2x2 / 4x2 meshes; (2) near-linear per-device
+    aggregator memory reduction as the feature axis widens, with a d
+    whose monolithic aggregator busts the per-device budget training
+    under a data x feature mesh; (3) zero fresh XLA traces across warm
+    solves including rho sweeps and adaptive rho; (4) exactly one
+    feature-axis vector all-reduce (+ one data-axis block all-reduce)
+    per compiled iteration, by HLO collective accounting."""
+    ndev = _ensure_virtual_devices(8)
+    if ndev < 8:
+        raise SystemExit("--admm needs 8 (virtual) devices")
+    if smoke:
+        par = dict(n=768, d=24, max_iterations=400, seed=7)
+        mem = dict(n=1024, d=256, widths=(1, 2, 4, 8), iters=25, seed=7)
+        tr = dict(n=512, d=16, seed=7)
+        col = dict(n=512, d=32, seed=7)
+    else:
+        par = dict(n=max(int(4096 * _SCALE), 768), d=48,
+                   max_iterations=800, seed=7)
+        mem = dict(n=max(int(4096 * _SCALE), 1024), d=1024,
+                   widths=(1, 2, 4, 8), iters=30, seed=7)
+        tr = dict(n=2048, d=24, seed=7)
+        col = dict(n=1024, d=64, seed=7)
+    entries = [_admm_parity_leg(**par), _admm_memory_leg(**mem),
+               _admm_trace_leg(**tr), _admm_collective_leg(**col)]
+    by_name = {e["name"]: e for e in entries}
+    mem_e = by_name["admm_memory"]
+    result = {
+        "metric": "admm_per_device_aggregator_reduction",
+        "value": mem_e["reduction_x"],
+        "unit": "x",
+        "detail": {
+            "entries": entries,
+            "parity_ok": by_name["admm_parity"]["parity_ok"],
+            "memory_ok": mem_e["memory_ok"],
+            "traces_ok": by_name["admm_warm_traces"]["traces_ok"],
+            "collectives_ok": by_name["admm_collectives"]["collectives_ok"],
+            "all_gates_ok": bool(
+                by_name["admm_parity"]["parity_ok"]
+                and mem_e["memory_ok"]
+                and by_name["admm_warm_traces"]["traces_ok"]
+                and by_name["admm_collectives"]["collectives_ok"]),
+            "devices": ndev,
+            "smoke": smoke,
+        },
+    }
+    _embed_telemetry(result)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
+# --------------------------------------------------------------------------
 # vectorized hyperparameter sweep benchmark (--sweep): K candidates, one
 # compiled program
 # --------------------------------------------------------------------------
@@ -6396,6 +6691,13 @@ def _dispatch():
                  and (i == 0 or rest[i - 1] != "--max-wall")]
         stoch_bench(*(paths[:1] or ["BENCH_stoch.json"]), smoke=smoke,
                     max_wall=_parse_max_wall(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--admm":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        admm_bench(*(paths[:1] or ["BENCH_admm.json"]), smoke=smoke,
+                   max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--sweep":
         smoke = "--smoke" in sys.argv[2:]
         rest = sys.argv[2:]

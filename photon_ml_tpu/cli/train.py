@@ -106,7 +106,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default="auto",
                    help="'auto' = all local devices on the data axis, 'none' "
                         "= single device, or 'DxF' (e.g. '4x2' = 4-way data "
-                        "x 2-way feature sharding)")
+                        "x 2-way feature sharding; F > 1 trains dense fixed "
+                        "effects on the feature-axis consensus-ADMM lane)")
     p.add_argument("--data-validation", default="full",
                    choices=["full", "sample", "disabled"],
                    help="input sanity-check intensity (reference: "
@@ -569,8 +570,12 @@ def _run(args, log) -> int:
 
     mesh = make_mesh_from_arg(args.mesh)
     if mesh is not None:
+        from photon_ml_tpu.parallel.mesh import FEATURE_AXIS
+        lanes = (" (feature axis > 1: dense fixed effects use the "
+                 "consensus-ADMM lane)"
+                 if mesh.shape.get(FEATURE_AXIS, 1) > 1 else "")
         print(f"mesh: {dict(mesh.shape)} over {len(mesh.devices.ravel())} "
-              f"devices", file=sys.stderr)
+              f"devices{lanes}", file=sys.stderr)
     evaluator_specs = args.evaluators.split(",") if args.evaluators else None
 
     # event hooks (reference: Driver.scala:108-118 registers listeners by

@@ -21,8 +21,8 @@ from typing import Dict, List, Optional, Sequence, Union
 from photon_ml_tpu.data.batching import RandomEffectDataConfig
 from photon_ml_tpu.ops.normalization import NormalizationType
 from photon_ml_tpu.optim import (
-    OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType,
-    SolverSchedule,
+    ADMMConfig, OptimizerConfig, OptimizerType, RegularizationContext,
+    RegularizationType, SolverSchedule,
 )
 
 
@@ -35,6 +35,11 @@ class GLMOptimizationConfig:
     regularization: RegularizationContext = RegularizationContext()
     regularization_weight: float = 0.0
     downsampling_rate: Optional[float] = None
+    # feature-axis consensus-ADMM lane knobs (optim/admm.py), consulted
+    # only when the lane is selected (shard_features on + mesh feature
+    # axis > 1 + dense/unnormalized/resident coordinate); None means the
+    # lane runs with ADMMConfig() defaults — it does NOT disable the lane
+    admm: Optional[ADMMConfig] = None
 
     def __post_init__(self):
         if self.regularization_weight < 0:
@@ -210,11 +215,22 @@ class GameTrainingConfig:
                     "track_coefficients": o.track_coefficients}
 
         def enc_glm(g: GLMOptimizationConfig):
-            return {"optimizer": enc_opt(g.optimizer),
-                    "regularization": {"type": g.regularization.reg_type.value,
-                                       "alpha": g.regularization.elastic_net_alpha},
-                    "regularization_weight": g.regularization_weight,
-                    "downsampling_rate": g.downsampling_rate}
+            out = {"optimizer": enc_opt(g.optimizer),
+                   "regularization": {"type": g.regularization.reg_type.value,
+                                      "alpha": g.regularization.elastic_net_alpha},
+                   "regularization_weight": g.regularization_weight,
+                   "downsampling_rate": g.downsampling_rate}
+            # only-when-set, like memory_mode: configs from before the ADMM
+            # lane existed keep byte-identical fingerprints
+            if g.admm is not None:
+                a = g.admm
+                out["admm"] = {"max_iterations": a.max_iterations,
+                               "tolerance": a.tolerance, "rho": a.rho,
+                               "adapt_rho": a.adapt_rho,
+                               "rho_tau": a.rho_tau, "rho_mu": a.rho_mu,
+                               "newton_steps": a.newton_steps,
+                               "polish": a.polish}
+            return out
 
         # None (no schedule) encodes as None, which checkpoint fingerprints
         # strip — records from before solver schedules existed stay resumable
@@ -281,13 +297,26 @@ class GameTrainingConfig:
                 track_coefficients=o.get("track_coefficients", False))
 
         def dec_glm(g: dict) -> GLMOptimizationConfig:
+            admm = None
+            if g.get("admm") is not None:
+                a = g["admm"]
+                admm = ADMMConfig(
+                    max_iterations=a.get("max_iterations"),
+                    tolerance=a.get("tolerance"),
+                    rho=a.get("rho", 1.0),
+                    adapt_rho=a.get("adapt_rho", True),
+                    rho_tau=a.get("rho_tau", 2.0),
+                    rho_mu=a.get("rho_mu", 10.0),
+                    newton_steps=a.get("newton_steps", 8),
+                    polish=a.get("polish", True))
             return GLMOptimizationConfig(
                 optimizer=dec_opt(g["optimizer"]),
                 regularization=RegularizationContext(
                     RegularizationType(g["regularization"]["type"]),
                     g["regularization"].get("alpha")),
                 regularization_weight=g["regularization_weight"],
-                downsampling_rate=g.get("downsampling_rate"))
+                downsampling_rate=g.get("downsampling_rate"),
+                admm=admm)
 
         coords: Dict[str, CoordinateConfig] = {}
         for name, c in d["coordinates"].items():

@@ -17,6 +17,7 @@ plain array arithmetic.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional, Tuple
 
 import jax
@@ -47,11 +48,16 @@ from photon_ml_tpu.ops import features as fops
 from photon_ml_tpu.ops.normalization import (
     NormalizationContext, NormalizationType, build_normalization_context,
 )
-from photon_ml_tpu.optim import SolveResult, solve
-from photon_ml_tpu.parallel.fixed_effect import _cached_solver, fit_fixed_effect
+from photon_ml_tpu.optim import ADMMConfig, SolveResult, solve
+from photon_ml_tpu.parallel.fixed_effect import (
+    _cached_solver, fit_fixed_effect, fit_fixed_effect_admm,
+    score_fixed_effect_admm,
+)
 from photon_ml_tpu.parallel.random_effect import (
     fit_random_effects, score_by_entity,
 )
+
+logger = logging.getLogger(__name__)
 
 
 @jax.jit
@@ -137,6 +143,48 @@ class FixedEffectCoordinate:
                                if config.shard_features is not None
                                else mesh is not None
                                and mesh.shape.get(FEATURE_AXIS, 1) > 1)
+        self._feature_div = 1
+        if mesh is not None:
+            self._feature_div = max(int(mesh.shape.get(FEATURE_AXIS, 1)), 1)
+        # feature sharding must have a consumer: with a feature axis > 1 the
+        # consensus-ADMM lane trains on it (dense, unnormalized, resident,
+        # unconstrained coordinates); anything else must not pretend — an
+        # explicit shard_features=True with NO mesh is a config error, and a
+        # blocked lane warns once per coordinate instead of silently
+        # training monolithically
+        if config.shard_features is True and mesh is None:
+            raise ValueError(
+                f"coordinate {name!r}: shard_features=True but no mesh — "
+                "nothing consumes the feature axis; build the estimator "
+                "with make_mesh(num_feature=...) or drop shard_features")
+        admm_blockers = []
+        if self.shard_features and self._feature_div > 1:
+            if self.streamed:
+                admm_blockers.append("memory_mode='streamed'")
+            if not is_dense:
+                admm_blockers.append("sparse feature shard")
+            if config.normalization != NormalizationType.NONE:
+                admm_blockers.append(
+                    f"normalization={config.normalization.value!r}")
+            opt_cfg = config.optimization.optimizer
+            if (opt_cfg.box_lower is not None or opt_cfg.box_upper is not None
+                    or opt_cfg.constraints is not None):
+                admm_blockers.append("box/named coefficient constraints")
+        self._admm_eligible = (self.shard_features and self._feature_div > 1
+                               and not admm_blockers)
+        if self.shard_features and self._feature_div > 1 and admm_blockers:
+            logger.warning(
+                "coordinate %r: shard_features is on but the feature-axis "
+                "ADMM lane is blocked by %s — training falls back to the "
+                "monolithic solver (coefficients merely ANNOTATED over the "
+                "feature axis, no memory scaling)", name,
+                ", ".join(admm_blockers))
+        elif config.shard_features is True and self._feature_div <= 1:
+            logger.warning(
+                "coordinate %r: shard_features=True but the mesh feature "
+                "axis has width 1 — no solver consumes it; build the mesh "
+                "with make_mesh(num_feature=...) to light up the ADMM lane",
+                name)
 
         self.norm: Optional[NormalizationContext] = None
         if config.normalization != NormalizationType.NONE:
@@ -200,7 +248,15 @@ class FixedEffectCoordinate:
             # first solve span).  The mesh path stages its padded + sharded
             # copy into the residency layer instead of a full single-device
             # copy.
-            if self._data_div > 1:
+            if self._admm_eligible:
+                # the ADMM lane trains AND scores through the column grid,
+                # so eager-stage that layout (the monolithic "x" entry only
+                # materializes if/when a polish pass asks for it)
+                from photon_ml_tpu.parallel.fixed_effect import (
+                    stage_admm_grid)
+                stage_admm_grid(self._mesh_key(), self.mesh,
+                                self._mesh_x_source())
+            elif self._data_div > 1:
                 from photon_ml_tpu.parallel.fixed_effect import (
                     staged_fixed_effect_x)
                 staged_fixed_effect_x(self._mesh_key(), self.mesh,
@@ -368,11 +424,35 @@ class FixedEffectCoordinate:
             obj = GLMObjective(self.loss, self._mesh_x_source(), self.labels,
                                weights=weights, offsets=offsets,
                                norm=self.norm)
-            res = fit_fixed_effect(obj, x0, self.mesh, opt.optimizer,
-                                   opt.regularization, opt.regularization_weight,
-                                   shard_features=self.shard_features,
-                                   budget=budget,
-                                   residency_key=self._mesh_key())
+            if self._admm_eligible:
+                # feature-axis consensus-ADMM lane: design columns shard
+                # over "feature" (2-D data x feature SPMD), per-iteration
+                # cost = one feature-axis vector psum + one data-axis
+                # block psum; the schedule maps budgets onto the ADMM
+                # iterations and gates the monolithic polish to the
+                # trailing outer iterations
+                admm_cfg = opt.admm if opt.admm is not None else ADMMConfig()
+                admm_budget = budget
+                if schedule is not None:
+                    admm_budget = schedule.budget_for(
+                        outer_iteration, num_outer_iterations, admm_cfg)
+                polish = None
+                polish_gate = getattr(schedule, "admm_polish", None)
+                if admm_cfg.polish and callable(polish_gate):
+                    polish = polish_gate(outer_iteration,
+                                         num_outer_iterations)
+                res = fit_fixed_effect_admm(
+                    obj, x0, self.mesh, admm_cfg, opt.optimizer,
+                    opt.regularization, opt.regularization_weight,
+                    budget=admm_budget, polish_budget=budget,
+                    polish=polish, residency_key=self._mesh_key())
+            else:
+                res = fit_fixed_effect(obj, x0, self.mesh, opt.optimizer,
+                                       opt.regularization,
+                                       opt.regularization_weight,
+                                       shard_features=self.shard_features,
+                                       budget=budget,
+                                       residency_key=self._mesh_key())
         else:
             obj = GLMObjective(self.loss, self.x, self.labels,
                                weights=weights, offsets=offsets,
@@ -403,6 +483,13 @@ class FixedEffectCoordinate:
         moves no data, and scores come back sharded over "data"."""
         if self.streamed:
             return self._stream.scores(model.glm.coefficients.means)
+        if self.mesh is not None and self._admm_eligible:
+            # score through the SAME staged column grid the ADMM lane
+            # trains on — an ADMM coordinate never stages a second
+            # (monolithic) design copy just to score
+            return score_fixed_effect_admm(model.glm, self._mesh_x_source(),
+                                           self.mesh,
+                                           residency_key=self._mesh_key())
         if self._data_div > 1:
             from photon_ml_tpu.parallel.fixed_effect import (
                 _cached_scorer, staged_fixed_effect_x)
@@ -608,8 +695,9 @@ class RandomEffectCoordinate(_EntityCoordinateBase):
                 budget=budget,
                 cache_key=(*self._mesh_key(), bucket.lane_start))
             results.append(res_b)
+        from photon_ml_tpu.parallel.mesh import concat_rows_safe
         res = (results[0] if len(results) == 1 else jax.tree_util.tree_map(
-            lambda *a: jnp.concatenate(a, axis=0), *results))
+            lambda *a: concat_rows_safe(self.mesh, a, axis=0), *results))
         new_model = dataclasses.replace(model, coefficients=res.x)
         return new_model, res
 

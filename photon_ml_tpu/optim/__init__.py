@@ -1,3 +1,6 @@
+from photon_ml_tpu.optim.admm import (  # noqa: F401
+    ADMMConfig, ADMMOperands, admm_solve,
+)
 from photon_ml_tpu.optim.config import (  # noqa: F401
     OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType, solve,
 )

@@ -163,6 +163,13 @@ class SolverSchedule:
     stochastic_merge: str = "sequential"
     stochastic_seed: int = 0
     stochastic_polish_iterations: int = 1
+    # feature-axis ADMM lane (optim/admm.py): a scheduled fit runs the
+    # monolithic polish only on the final `admm_polish_iterations` outer
+    # iterations — early visits are re-perturbed next visit anyway, so
+    # polishing them wastes a full strict solve per visit.  The ADMM
+    # iteration budgets themselves come from the SAME budget_for
+    # (ADMMConfig.resolved() duck-types OptimizerConfig)
+    admm_polish_iterations: int = 1
 
     def __post_init__(self):
         if self.initial_iterations < 1:
@@ -186,6 +193,10 @@ class SolverSchedule:
                              "(the final outer iterations ALWAYS polish "
                              "with the strict solver — parity at the fixed "
                              "point depends on it)")
+        if self.admm_polish_iterations < 1:
+            raise ValueError("admm_polish_iterations must be >= 1 (an "
+                             "ADMM-lane fit with polish enabled always "
+                             "polishes its final outer iteration)")
 
     def plan(self, outer_iteration: int, num_outer_iterations: int,
              max_iterations: int, tolerance: float) -> Tuple[int, float]:
@@ -227,6 +238,16 @@ class SolverSchedule:
                               merge=self.stochastic_merge,
                               seed=self.stochastic_seed)
 
+    def admm_polish(self, outer_iteration: int,
+                    num_outer_iterations: int) -> bool:
+        """Whether an ADMM-lane visit on this outer iteration should run
+        the monolithic polish (only the final `admm_polish_iterations`
+        visits do; an unscheduled fit polishes every visit).  The caller
+        still ANDs this with the ADMMConfig's own polish flag — a config
+        with polish=False never polishes regardless of schedule."""
+        polish_from = num_outer_iterations - self.admm_polish_iterations
+        return outer_iteration >= polish_from
+
     # -- JSON round-trip (game/config.py embeds schedules in model metadata)
     def to_dict(self) -> dict:
         d = {"initial_iterations": self.initial_iterations,
@@ -245,6 +266,9 @@ class SolverSchedule:
                 "stochastic_polish_iterations":
                     self.stochastic_polish_iterations,
             })
+        # same only-when-set discipline for the ADMM lane key
+        if self.admm_polish_iterations != 1:
+            d["admm_polish_iterations"] = self.admm_polish_iterations
         return d
 
     @staticmethod
@@ -261,7 +285,8 @@ class SolverSchedule:
             stochastic_merge=d.get("stochastic_merge", "sequential"),
             stochastic_seed=d.get("stochastic_seed", 0),
             stochastic_polish_iterations=d.get(
-                "stochastic_polish_iterations", 1))
+                "stochastic_polish_iterations", 1),
+            admm_polish_iterations=d.get("admm_polish_iterations", 1))
 
 
 @dataclasses.dataclass(frozen=True)

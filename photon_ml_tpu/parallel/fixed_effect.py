@@ -34,6 +34,7 @@ from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel, model_for_task
 from photon_ml_tpu.ops import GLMObjective
 from photon_ml_tpu.optim import OptimizerConfig, RegularizationContext, SolveResult, solve
+from photon_ml_tpu.optim.admm import ADMMConfig, ADMMOperands, admm_solve
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, data_sharding, replicated
 
 
@@ -188,6 +189,227 @@ def fit_fixed_effect(
         return _cached_solver(config, reg)(sharded_obj, x0,
                                            jnp.asarray(reg_weight, x0.dtype),
                                            budget)
+
+
+# -- consensus-ADMM lane: column-sharded staging + fit -------------------------
+
+def _grid_view(x, num_feature: int, block_width: int):
+    """[n, d] dense design -> [n, F, d_F] column-block grid (zero-padded
+    columns).  A pure reshape VIEW when d == F * d_F and the source is
+    contiguous host numpy — the common case pays no host copy."""
+    n, d = x.shape
+    d_pad = num_feature * block_width
+    if isinstance(x, np.ndarray):
+        if d == d_pad and x.flags.c_contiguous:
+            return x.reshape(n, num_feature, block_width)
+        out = np.zeros((n, d_pad), x.dtype)
+        out[:, :d] = x
+        return out.reshape(n, num_feature, block_width)
+    x = jnp.asarray(x)
+    if d != d_pad:
+        x = jnp.pad(x, ((0, 0), (0, d_pad - d)))
+    return x.reshape(n, num_feature, block_width)
+
+
+def _fold_x0(x0, num_feature: int, block_width: int):
+    """[d] warm start -> [F, d_F] shard grid (zero-padded tail)."""
+    d = x0.shape[0]
+    d_pad = num_feature * block_width
+    if isinstance(x0, np.ndarray):
+        out = np.zeros(d_pad, x0.dtype)
+        out[:d] = x0
+        return out.reshape(num_feature, block_width)
+    x0 = jnp.asarray(x0)
+    if d != d_pad:
+        x0 = jnp.pad(x0, (0, d_pad - d))
+    return x0.reshape(num_feature, block_width)
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_gram_eig(mesh: Mesh):
+    """Per-shard Gram eigendecomposition G_j = Q_j diag(lam_j) Q_j^T from
+    the staged design grid — the transpose-reduction cache that makes the
+    ADMM w-update closed form for ANY traced shift.  The Gram itself is
+    never stored: only (Q, lam), [F, d_F, d_F] + [F, d_F] sharded over
+    "feature" (out_shardings pin this so per-device aggregator memory is
+    d_F^2, shrinking quadratically as the feature axis widens — the
+    bench's memory gate).  Unweighted by construction, so downsampling /
+    per-visit weights never invalidate it (they only reweight the z-prox)."""
+    out_sh = (NamedSharding(mesh, P(FEATURE_AXIS, None, None)),
+              NamedSharding(mesh, P(FEATURE_AXIS, None)))
+
+    def gram_eig(x_grid):
+        gram = jnp.einsum("nfa,nfb->fab", x_grid, x_grid)
+        lam, q = jnp.linalg.eigh(gram)
+        return q, lam
+
+    return jax.jit(gram_eig, out_shardings=out_sh)
+
+
+def stage_admm_grid(key, mesh: Mesh, x, residency=None):
+    """Memoized column-block grid for one coordinate: update and score
+    share ONE staged [n_pad, F, d_F] copy (field "x_grid", spec "grid"),
+    the ADMM analogue of `staged_fixed_effect_x`.  Returns
+    (n, d, block_width, x_grid)."""
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    res = residency if residency is not None else default_residency()
+    num_feature = mesh.shape[FEATURE_AXIS]
+    n, d = x.shape
+    block_width = -(-d // num_feature)
+    x_grid = res.stage_static(
+        key, "x_grid", mesh, x, 0.0, spec="grid",
+        build=lambda: _grid_view(x, num_feature, block_width))
+    return n, d, block_width, x_grid
+
+
+def _stage_admm_operands(objective: GLMObjective, mesh: Mesh, key,
+                         residency=None):
+    """Stage the ADMM lane's device operands through the residency layer:
+    the column-block grid + its Gram eigendecomposition cold (once per
+    (coordinate, mesh); derived compute under the "admm.stage" fault
+    site), labels/weights/mask via the SAME fields the monolithic lane
+    stages (shared cold entries), offsets warm per visit.  Returns
+    (ADMMOperands-without-reg-weights as a dict, n, d, d_F)."""
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    res = residency if residency is not None else default_residency()
+    labels = objective.labels
+    n, d, block_width, x_grid = stage_admm_grid(key, mesh, objective.x,
+                                                residency=res)
+    q_eig, lam_eig = res.stage_derived(
+        key, "gram_eig", mesh, x_grid,
+        lambda: _cached_gram_eig(mesh)(x_grid))
+    labels_dev = res.stage_static(key, "labels", mesh, labels, 0.5)
+    weights_dev = res.stage_static(key, "weights", mesh, objective.weights,
+                                   0.0)
+    if objective.mask is not None:
+        mask_dev = res.stage_static(key, "mask", mesh, objective.mask, 0.0)
+    else:
+        mask_dev = res.stage_static(
+            key, "mask", mesh, labels, 0.0,
+            build=lambda: np.ones(labels.shape[0],
+                                  jax.dtypes.canonicalize_dtype(labels.dtype)))
+    offsets_dev = res.stage_update(mesh, objective.offsets, 0.0, key=key,
+                                   field="offsets")
+    return dict(x_grid=x_grid, q_eig=q_eig, lam_eig=lam_eig,
+                labels=labels_dev, weights=weights_dev, mask=mask_dev,
+                offsets=offsets_dev), n, d, block_width
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_kappa():
+    # weights * mask fused once per visit (tiny [n] product; padded and
+    # downsampled-out rows land at exactly 0 so the z-prox ignores them)
+    return jax.jit(lambda w, m: m if w is None else w * m)
+
+
+def fit_fixed_effect_admm(
+    objective: GLMObjective,
+    x0: jax.Array,
+    mesh: Mesh,
+    admm_config: ADMMConfig = ADMMConfig(),
+    config: OptimizerConfig = OptimizerConfig(),
+    reg: RegularizationContext = RegularizationContext(),
+    reg_weight: jax.Array | float = 0.0,
+    budget=None,
+    polish_budget=None,
+    polish: Optional[bool] = None,
+    residency_key=None,
+) -> SolveResult:
+    """One feature-sharded fixed-effect solve on the consensus-ADMM lane
+    (optim/admm.py): the design grid column-shards over the mesh's
+    "feature" axis AND row-shards over "data" (2-D SPMD), per-shard
+    aggregators (Gram eigenbases) stay feature-local, and each iteration
+    costs one feature-axis vector psum + one data-axis block psum.
+
+    Requires a DENSE 2-D design block and no normalization context —
+    callers (FixedEffectCoordinate) fall back to the monolithic lane
+    otherwise.  `budget` follows the SolveBudget discipline for the ADMM
+    iterations; `polish` (default: the config's flag) runs the strict
+    monolithic solver once afterwards, warm-started from the consensus
+    solution under `polish_budget` (None = the optimizer config's statics)
+    — exact parity with the host-stepped lane, at the cost of re-staging
+    the unsplit design and replicating the full [d] iterate.  Wide-model
+    callers set polish=False."""
+    if not isinstance(objective.x, (np.ndarray, jnp.ndarray, jax.Array)) \
+            or np.ndim(objective.x) != 2:
+        raise ValueError(
+            "the ADMM lane needs a dense 2-D design block; sparse / "
+            "structured FeatureMatrix coordinates use the monolithic lane")
+    if objective.norm is not None:
+        raise ValueError(
+            "the ADMM lane does not compose with normalization contexts "
+            "(per-shard Gram caching assumes raw columns); normalize the "
+            "data or use the monolithic lane")
+    if config.box_lower is not None or config.box_upper is not None \
+            or config.constraints is not None:
+        raise ValueError("box/named constraints are a monolithic-lane "
+                         "feature; the ADMM lane does not project")
+    key = residency_key if residency_key is not None else ("admm", "anon")
+    staged, n, d, block_width = _stage_admm_operands(
+        objective, mesh, key)
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    num_feature = mesh.shape[FEATURE_AXIS]
+    w0 = default_residency().stage_update(
+        mesh, _fold_x0(x0, num_feature, block_width), spec="feature",
+        key=key, field="x0")
+    from photon_ml_tpu.optim.schedule import RegWeights
+    if isinstance(reg_weight, RegWeights):
+        l1_w, l2_w = reg_weight.l1_weight, reg_weight.l2_weight
+    else:
+        l1_w, l2_w = reg.split(reg_weight)
+    dtype = staged["x_grid"].dtype
+    with mesh:
+        kappa = _cached_kappa()(staged["weights"], staged["mask"])
+        ops = ADMMOperands(
+            x_grid=staged["x_grid"], q_eig=staged["q_eig"],
+            lam_eig=staged["lam_eig"], labels=staged["labels"], kappa=kappa,
+            offsets=staged["offsets"], l1_weight=jnp.asarray(l1_w, dtype),
+            l2_weight=jnp.asarray(l2_w, dtype))
+        result = admm_solve(objective.loss, reg.has_l1, ops, w0,
+                            admm_config, budget=budget)
+    result = result._replace(x=result.x[:d])
+    do_polish = admm_config.polish if polish is None else polish
+    if do_polish:
+        admm_iterations = result.iterations
+        result = fit_fixed_effect(
+            objective, result.x, mesh, config, reg, reg_weight,
+            shard_features=False, budget=polish_budget,
+            residency_key=residency_key)
+        result = result._replace(
+            iterations=result.iterations + admm_iterations)
+    return result
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_admm_scorer():
+    def _score(means, x_grid, offsets):
+        num_feature, block_width = x_grid.shape[1], x_grid.shape[2]
+        d = means.shape[0]
+        w = jnp.pad(means, (0, num_feature * block_width - d))
+        z = jnp.einsum("nfa,fa->n", x_grid,
+                       w.reshape(num_feature, block_width))
+        return z if offsets is None else z + offsets
+    return jax.jit(_score)
+
+
+def score_fixed_effect_admm(model: GeneralizedLinearModel, x, mesh: Mesh,
+                            offsets: Optional[jax.Array] = None,
+                            residency_key=None) -> jax.Array:
+    """Sharded margins through the ADMM lane's staged column grid — scoring
+    shares the SAME cold x_grid entry the solver staged, so an ADMM
+    coordinate never pays for a second (monolithic) design copy just to
+    score.  Scores come back sharded over "data", padding sliced off."""
+    from photon_ml_tpu.parallel.mesh_residency import default_residency
+    res = default_residency()
+    key = residency_key if residency_key is not None else ("admm", "anon")
+    n, _, _, x_grid = stage_admm_grid(key, mesh, x, residency=res)
+    offsets_dev = (None if offsets is None else
+                   res.stage_update(mesh, offsets, 0.0, key=key,
+                                    field="offsets"))
+    with mesh:
+        scores = _cached_admm_scorer()(model.coefficients.means, x_grid,
+                                       offsets_dev)
+    return scores[:n]
 
 
 @functools.lru_cache(maxsize=8)

@@ -19,9 +19,15 @@ Mesh axes:
   - "data":    batch rows (fixed effect) — pure data parallelism (P1);
                also reused as the entity axis for random effects (P2), since
                both shard the leading dimension of their arrays.
-  - "feature": optional second axis to shard very wide coefficient vectors
-               (the reference's feature-scaling axis, SURVEY §5.7): gradients
-               become reduce_scatter + all_gather rides ICI.
+  - "feature": second axis sharding very wide coefficient vectors (the
+               reference's feature-scaling axis, SURVEY §5.7).  Since PR 18
+               this axis is LIVE: the consensus-ADMM fixed-effect lane
+               (optim/admm.py) column-shards the design grid
+               P("data", "feature", None) and its per-shard Gram
+               eigendecompositions P("feature", ...), paying one [n]-vector
+               psum over "feature" per iteration (the margin consensus) plus
+               one [F, d_F] psum over "data" (the transpose-reduction
+               residual product).  Width-1 keeps the monolithic solvers.
 
 Multi-host: jax.distributed + the same Mesh spanning hosts; DCN-spanning
 meshes put "data" outermost so gradient psums ride ICI within a slice and
@@ -45,13 +51,28 @@ def make_mesh(num_data: Optional[int] = None, num_feature: int = 1,
     """A (data, feature) mesh over the available devices.
 
     Defaults to all devices on the data axis — the right layout for GLM
-    training where batch/entity sharding dominates and d is modest.
+    training where batch/entity sharding dominates and d is modest; pass
+    `num_feature > 1` to give the consensus-ADMM lane a feature axis for
+    wide models.  "data" is the OUTERMOST axis by construction: on
+    DCN-spanning topologies the slower links land on the data axis, so the
+    per-iteration feature-axis psums (and the feature-sharded Gram blocks)
+    stay on ICI within a slice and only the data-axis reduction crosses
+    DCN — the hierarchical layout `initialize_multihost` relies on.
+
+    Raises ValueError when the requested shape does not tile the device
+    list exactly (the error names both, plus the inferred-`num_data` hint).
     """
     devices = list(devices if devices is not None else jax.devices())
     if num_data is None:
         num_data = len(devices) // num_feature
     if num_data * num_feature != len(devices):
-        raise ValueError(f"mesh {num_data}x{num_feature} != {len(devices)} devices")
+        raise ValueError(
+            f"requested mesh shape data={num_data} x feature={num_feature} "
+            f"(= {num_data * num_feature} devices) does not tile the "
+            f"{len(devices)}-device list; pass num_data/num_feature whose "
+            f"product is {len(devices)}, or num_data=None to infer it as "
+            f"len(devices) // num_feature ('data' is the outermost, "
+            f"DCN-friendly axis)")
     arr = np.asarray(devices).reshape(num_data, num_feature)
     return Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
 
@@ -91,9 +112,50 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def feature_sharding(mesh: Mesh) -> NamedSharding:
-    """[d] vectors split over the "feature" axis (wide fixed-effect models)."""
-    return NamedSharding(mesh, P(FEATURE_AXIS))
+def feature_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Leading axis split over "feature", rest replicated — [d] coefficient
+    vectors, and the ADMM lane's [F, ...] per-shard aggregates (Gram
+    eigenbases, dual blocks)."""
+    return NamedSharding(mesh, P(FEATURE_AXIS, *([None] * (ndim - 1))))
+
+
+def grid_sharding(mesh: Mesh, ndim: int = 3) -> NamedSharding:
+    """[n, F, ...] design grids split over BOTH axes — rows over "data",
+    column blocks over "feature" (the ADMM lane's 2-D data x feature
+    layout)."""
+    return NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS,
+                                 *([None] * (ndim - 2))))
+
+
+def concat_rows_safe(mesh: Optional[Mesh], arrays, axis: int = 0):
+    """`jnp.concatenate` that is safe for row-sharded operands on a mesh
+    whose "feature" axis is wider than 1.
+
+    On such meshes this build's GSPMD lowers a concatenate of
+    P("data", ...)-sharded operands — eager or jitted — to a wrong
+    resharding program: the output silently interleaves values from other
+    shards (observed maxdiff O(1e3) on a (4, 2) mesh; exact on (8, 1)).
+    The workaround routes through layouts verified exact on the same mesh:
+    reshard every part to replicated, concatenate there, and place the
+    result back row-sharded when the row count tiles the data axis
+    (replicated otherwise — correct either way, and the consumers gather).
+
+    Single-axis meshes and mesh-less callers keep the direct concatenate,
+    which is both correct and cheaper there.  The replicate hop is device
+    to device (no host sync) and the callers concatenate per-entity
+    coefficient tables, so the extra bytes are small.
+    """
+    arrays = list(arrays)
+    if len(arrays) == 1:
+        return arrays[0]
+    if mesh is None or mesh.shape.get(FEATURE_AXIS, 1) <= 1:
+        return jnp.concatenate(arrays, axis=axis)
+    rep = replicated(mesh)
+    out = jnp.concatenate([jax.device_put(a, rep) for a in arrays],
+                          axis=axis)
+    if axis == 0 and out.shape[0] % mesh.shape[DATA_AXIS] == 0:
+        out = jax.device_put(out, data_sharding(mesh, out.ndim))
+    return out
 
 
 def pad_and_shard_rows(mesh: Mesh, *arrays, residency_key=None):

@@ -53,7 +53,8 @@ import numpy as np
 
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.parallel.mesh import (
-    DATA_AXIS, data_sharding, feature_sharding, replicated,
+    DATA_AXIS, FEATURE_AXIS, data_sharding, feature_sharding, grid_sharding,
+    replicated,
 )
 from photon_ml_tpu.store.base import with_retries
 from photon_ml_tpu.store.handles import ResidencyRegistry
@@ -148,6 +149,13 @@ def _pad_axis0(a, rem: int, fill):
         out[a.shape[0]:] = fill
         return out
     a = jnp.asarray(a)
+    sh = getattr(a, "sharding", None)
+    if (getattr(sh, "mesh", None) is not None
+            and sh.mesh.shape.get(FEATURE_AXIS, 1) > 1):
+        # concatenate of row-sharded operands miscompiles on feature-wide
+        # meshes (see parallel.mesh.concat_rows_safe); pad in the
+        # replicated layout — the following _put_leaf reshards anyway
+        a = jax.device_put(a, replicated(sh.mesh))
     return jnp.concatenate([a, jnp.full((rem,) + a.shape[1:], fill, a.dtype)])
 
 
@@ -159,7 +167,9 @@ def _put_leaf(mesh, leaf, spec: str):
     if spec == "replicated" or np.ndim(leaf) == 0:
         return jax.device_put(leaf, replicated(mesh))
     if spec == "feature":
-        return jax.device_put(leaf, feature_sharding(mesh))
+        return jax.device_put(leaf, feature_sharding(mesh, np.ndim(leaf)))
+    if spec == "grid":
+        return jax.device_put(leaf, grid_sharding(mesh, np.ndim(leaf)))
     return jax.device_put(leaf, data_sharding(mesh, np.ndim(leaf)))
 
 
@@ -172,15 +182,19 @@ def _stage_tree(mesh, tree, fill, spec: str):
     if isinstance(tree, (np.ndarray, jnp.ndarray, jax.Array)) \
             or not hasattr(tree, "tree_flatten"):
         a = tree if hasattr(tree, "shape") else np.asarray(tree)
-        if spec == "data":
+        if spec in ("data", "grid"):
             rem = (-a.shape[0]) % mesh.shape[DATA_AXIS]
             a = _pad_axis0(a, rem, fill)
         staged = _put_leaf(mesh, a, spec)
         return staged, int(staged.nbytes)
     # FeatureMatrix pytree (PaddedSparse / KroneckerDesign): pad via the
-    # shared pad_rows, then shard every array leaf on its leading axis
-    rem = (-tree.shape[0]) % mesh.shape[DATA_AXIS]
-    padded = fops.pad_rows(tree, rem)
+    # shared pad_rows, then shard every array leaf on its leading axis.
+    # Row-shaped pytrees carry a .shape; others (NormalizationContext
+    # stats, [d]-shaped) have no row axis to pad — just place the leaves.
+    padded = tree
+    if hasattr(tree, "shape"):
+        rem = (-tree.shape[0]) % mesh.shape[DATA_AXIS]
+        padded = fops.pad_rows(tree, rem)
     staged = jax.tree_util.tree_map(lambda l: _put_leaf(mesh, l, spec),
                                     padded)
     nbytes = sum(int(l.nbytes) for l in jax.tree_util.tree_leaves(staged))
@@ -257,6 +271,47 @@ class MeshResidency:
         staged, _ = self._transfer_with_retry(
             mesh, build if build is not None else source, fill, spec,
             key, field, warm=False)
+        if replacing:
+            self.stats.note_invalidation()
+        self._registry.commit(full_key, source, staged)
+        return staged
+
+    def stage_derived(self, key, field: str, mesh, source,
+                      build: Callable[[], object], *,
+                      site: str = "admm.stage"):
+        """Memoized DEVICE-derived residency: run `build()` (device
+        compute, e.g. the ADMM lane's per-shard Gram eigendecomposition)
+        once per (key, field, mesh) and pin the result, anchored on the
+        staged `source` array's identity — when the source re-stages (the
+        coordinate re-built its blocks), the derived entry re-derives and
+        counts an invalidation, exactly like stage_static.
+
+        The derivation runs under the store's transient/fatal retry
+        discipline at the given fault site (default "admm.stage", the ADMM
+        lane's only host-boundary site — the consensus step itself does no
+        host-visible I/O); its bytes count COLD, since a derived aggregate
+        is static coordinate data that must never re-materialize across
+        warm visits."""
+        full_key = (_as_tuple(key), field, _mesh_fingerprint(mesh))
+        staged, replacing = self._registry.lookup(full_key, source)
+        if staged is not None:
+            return staged
+
+        def derive():
+            with telemetry.span("mesh_stage", key=str(key), field=field,
+                                warm=False):
+                out = build()
+                # surface async device failures inside the retry scope
+                jax.block_until_ready(out)
+            nbytes = sum(int(l.nbytes)
+                         for l in jax.tree_util.tree_leaves(out))
+            self.stats.note_stage(nbytes, warm=False)
+            return out
+
+        staged = with_retries(
+            derive, site=site, what=f"{key!r}/{field}",
+            on_retry=self.stats.note_retry, jitter=self._jitter,
+            error_cls=MeshStagingError, key=str(key), field=field)
         if replacing:
             self.stats.note_invalidation()
         self._registry.commit(full_key, source, staged)

@@ -28,6 +28,7 @@ EVENTS: Dict[str, str] = {
     "stage.fetch": "fault",
     "stage.transfer": "fault",
     "mesh.stage": "fault",
+    "admm.stage": "fault",
     "checkpoint.write": "fault",
     "checkpoint.fsync": "fault",
     "model.save": "fault",

@@ -46,6 +46,17 @@ Injection sites currently threaded (ctx keys in parentheses):
                     pad_and_shard_rows scoring path); transient faults
                     retry with the Prefetcher's backoff discipline,
                     fatal ones raise MeshStagingError
+  admm.stage        ADMM derived-aggregate staging (key, field)
+                    (parallel/mesh_residency.stage_derived: the consensus
+                    lane's per-shard Gram eigendecomposition, built on
+                    device and pinned per (coordinate, mesh)); transient
+                    faults retry with the staging backoff discipline —
+                    the derivation is deterministic so the retry is
+                    bit-exact — fatal ones raise MeshStagingError.  There
+                    is deliberately NO solve.consensus site: the ADMM
+                    iteration keeps duals/consensus state in the on-device
+                    while_loop carry and does no host-visible I/O, so the
+                    staging boundary is the lane's only fault surface
   checkpoint.write  checkpoint record write start  (iteration)
   checkpoint.fsync  after state.json.tmp fsync,    (iteration)
                     before the atomic rename — a "kill" here is the
@@ -149,6 +160,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "stage.fetch": ("chunk",),
     "stage.transfer": ("chunk",),
     "mesh.stage": ("key", "field"),
+    "admm.stage": ("key", "field"),
     "checkpoint.write": ("iteration",),
     "checkpoint.fsync": ("iteration",),
     "model.save": ("directory",),

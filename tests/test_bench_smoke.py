@@ -110,6 +110,45 @@ def test_sweep_smoke(tmp_path):
     assert counters["sweep.dispatches"] > 0
 
 
+def test_admm_smoke(tmp_path):
+    """bench.py --admm --smoke end-to-end in tier-1 (ISSUE 18 satellite):
+    the feature-axis consensus-ADMM gates — f64 parity <= 1e-6 of the
+    pure consensus solve vs monolithic LBFGS across 1x1/1x2/2x2/4x2
+    meshes, near-linear per-device aggregator memory reduction as the
+    feature axis widens (with the monolithic layout busting the
+    per-device budget and the widest mesh training inside it), zero
+    fresh XLA traces across warm solves and rho sweeps, and exactly one
+    feature-axis vector all-reduce per compiled iteration — run on every
+    tier-1 pass, so the lane cannot silently regress into retracing,
+    extra collectives or divergence."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_admm.json"
+    result = bench.admm_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_gates_ok"] is True
+    assert detail["parity_ok"] and detail["memory_ok"]
+    assert detail["traces_ok"] and detail["collectives_ok"]
+    par = next(e for e in detail["entries"] if e["name"] == "admm_parity")
+    assert par["worst_rel_gap"] <= 1e-6
+    assert {c["mesh"] for c in par["cells"]} == {"1x1", "1x2", "2x2", "4x2"}
+    mem = next(e for e in detail["entries"] if e["name"] == "admm_memory")
+    assert mem["monolithic_busts_budget"] and mem["wide_fits_budget"]
+    assert mem["wide_trains"] and result["value"] >= 2.0
+    tr = next(e for e in detail["entries"]
+              if e["name"] == "admm_warm_traces")
+    assert tr["fresh_traces"] == 0
+    col = next(e for e in detail["entries"]
+               if e["name"] == "admm_collectives")
+    assert col["feature_vector_allreduces"] == 1
+    assert col["data_block_allreduces"] == 1
+
+
 def test_stream_smoke(tmp_path):
     """bench.py --stream --smoke end-to-end in tier-1 (ISSUE 3 satellite):
     the out-of-core harness — ChunkedGLMObjective streaming, HBM-budgeted
