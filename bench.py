@@ -3124,6 +3124,296 @@ def mesh_bench(out_path="BENCH_mesh.json", smoke=False, max_wall=None,
     return result
 
 
+def _mh_free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _mh_write_inputs(root, n, d, outer, seed=3):
+    from photon_ml_tpu.data import build_game_dataset
+    from photon_ml_tpu.data.game_data import save_game_dataset
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-x @ w))).astype(
+        np.float64)
+    data = os.path.join(root, "data.npz")
+    if not os.path.exists(data):
+        save_game_dataset(build_game_dataset(y, {"global": x}), data)
+    config = os.path.join(root, f"game-{outer}.json")
+    with open(config, "w") as f:
+        json.dump({
+            "task_type": "logistic_regression",
+            "coordinates": {
+                "fixed": {
+                    "kind": "fixed_effect",
+                    "feature_shard": "global",
+                    "optimization": {
+                        "optimizer": {"optimizer": "lbfgs",
+                                      "max_iterations": 3},
+                        "regularization": {"type": "l2"},
+                        "regularization_weight": 1.0,
+                    },
+                }
+            },
+            "updating_sequence": ["fixed"],
+            "num_outer_iterations": outer,
+        }, f)
+    return data, config
+
+
+_MH_HEARTBEAT_ENV = {
+    "PHOTON_HEARTBEAT_INTERVAL": "0.2",
+    "PHOTON_HEARTBEAT_TIMEOUT": "2",
+    "PHOTON_HEARTBEAT_ESCALATE": "5",
+}
+
+
+def _mh_spawn(data, config, out_dir, *, devices, coordinator=None,
+              num_processes=None, process_id=None):
+    """One cli.train worker subprocess (its own jax runtime: multi-process
+    meshes cannot share the bench's)."""
+    cmd = [sys.executable, "-m", "photon_ml_tpu.cli.train",
+           "--train-data", data, "--config", config, "--x64",
+           "--mesh", "auto", "--no-compile-cache",
+           "--checkpoint-dir", os.path.join(out_dir, "ckpt"),
+           "--output-dir", out_dir]
+    if coordinator is not None:
+        cmd += ["--coordinator", coordinator,
+                "--num-processes", str(num_processes),
+                "--process-id", str(process_id)]
+    env = dict(os.environ)
+    for k in ("PHOTON_COORDINATOR", "PHOTON_NUM_PROCESSES",
+              "PHOTON_PROCESS_ID"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    env.update(_MH_HEARTBEAT_ENV)
+    tag = "" if process_id is None else f".proc{process_id}"
+    out_path = os.path.join(out_dir, f"worker{tag}.out")
+    out = open(out_path, "w")
+    err = open(os.path.join(out_dir, f"worker{tag}.err"), "w")
+    proc = subprocess.Popen(cmd, cwd=os.path.dirname(
+        os.path.abspath(__file__)), env=env, stdout=out, stderr=err)
+    proc._mh_streams = (out, err)
+    proc._mh_out_path = out_path
+    return proc
+
+
+def _mh_finish(proc, timeout=240):
+    try:
+        rc = proc.wait(timeout=timeout)
+    finally:
+        for h in proc._mh_streams:
+            h.close()
+    return rc
+
+
+def _mh_last_json(path):
+    for ln in reversed([x for x in open(path).read().splitlines()
+                        if x.strip()]):
+        try:
+            return json.loads(ln)
+        except ValueError:
+            continue
+    raise RuntimeError(f"no JSON summary line in {path}")
+
+
+def _mh_run_pair(data, config, out_dir):
+    os.makedirs(out_dir, exist_ok=True)
+    port = _mh_free_port()
+    workers = [_mh_spawn(data, config, out_dir, devices=1,
+                         coordinator=f"localhost:{port}", num_processes=2,
+                         process_id=pid) for pid in (0, 1)]
+    return [(_mh_finish(w), w._mh_out_path) for w in workers]
+
+
+def _mh_model_bytes(out_dir):
+    best = os.path.join(out_dir, "best")
+    out = {}
+    for root, _, names in os.walk(best):
+        for fn in names:
+            if fn == "model-metadata.json":  # carries timestamps
+                continue
+            p = os.path.join(root, fn)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, best)] = f.read()
+    return out
+
+
+def multihost_bench(out_path="BENCH_multihost.json", smoke=False,
+                    max_wall=None):
+    """Multi-host data-mesh training (ISSUE 19): jax.distributed
+    bring-up on 2 subprocess workers (1 virtual CPU device each) against
+    a 1-process x 2-device mirror of the SAME global mesh, with hard
+    gates on (1) f64 objective-history parity <= 1e-8 across process
+    counts (expected: bit-exact — same mesh shape => same GSPMD
+    program), (2) zero fresh XLA traces across warm outer iterations on
+    BOTH processes, (3) per-process staging: cold bytes symmetric across
+    hosts (each stages ~1/P of the rows) and warm per-iteration bytes
+    bounded by vector traffic, (4) lost-worker containment: SIGKILL one
+    worker mid-run -> the survivor exits 75 with checkpoint-consistent
+    state -> a 1-process relaunch resumes bit-exactly vs an
+    uninterrupted reference.  Wall-clock is reported ungated (virtual
+    CPU devices share one host's cores)."""
+    import shutil
+    import signal
+    import tempfile
+
+    suite_t0 = time.perf_counter()
+    n, d = (512, 8) if smoke else (max(int(20_000 * _SCALE), 2048), 16)
+    outer_long, outer_short = (6, 3) if smoke else (10, 4)
+    root = tempfile.mkdtemp(prefix="bench_multihost_")
+    detail = {"processes": 2, "n": n, "d": d,
+              "outer_iterations": outer_long, "smoke": smoke}
+    truncated = []
+    try:
+        data, config = _mh_write_inputs(root, n, d, outer_long)
+        _, config_short = _mh_write_inputs(root, n, d, outer_short)
+
+        # -- leg 1+2+3: the 2-process pair, its 1-process mirror, and a
+        # shorter pair for the warm-trace differential
+        two = os.path.join(root, "two")
+        ref = os.path.join(root, "ref")
+        t0 = time.perf_counter()
+        pair = _mh_run_pair(data, config, two)
+        pair_wall = time.perf_counter() - t0
+        os.makedirs(ref, exist_ok=True)
+        t0 = time.perf_counter()
+        rp = _mh_spawn(data, config, ref, devices=2)
+        ref_rc = _mh_finish(rp)
+        ref_wall = time.perf_counter() - t0
+        if any(rc != 0 for rc, _ in pair) or ref_rc != 0:
+            raise RuntimeError(
+                f"multihost bench run failed: pair rc="
+                f"{[rc for rc, _ in pair]} ref rc={ref_rc}")
+
+        with open(os.path.join(two, "ckpt", "state.json")) as f:
+            h2 = np.asarray(json.load(f)["objective_history"], np.float64)
+        with open(os.path.join(ref, "ckpt", "state.json")) as f:
+            h1 = np.asarray(json.load(f)["objective_history"], np.float64)
+        parity_gap = float(np.max(np.abs(h2 - h1))) \
+            if h2.shape == h1.shape else float("inf")
+        m2, m1 = _mh_model_bytes(two), _mh_model_bytes(ref)
+        model_bit_identical = bool(m2) and m2 == m1
+
+        s0 = _mh_last_json(pair[0][1])
+        s1 = _mh_last_json(pair[1][1])
+        cold = [s["mesh_transfer"]["cold_bytes"] for s in (s0, s1)]
+        warm = [s["mesh_transfer"]["warm_bytes"] for s in (s0, s1)]
+        warm_bound = 8 * (n // 2 + d) * 8  # vectors + slack, per iteration
+        staging_ok = (min(cold) > 0
+                      and max(cold) / max(1, min(cold)) <= 1.5
+                      and all(w / outer_long <= warm_bound for w in warm))
+
+        if max_wall is not None and \
+                time.perf_counter() - suite_t0 > max_wall:
+            truncated.append("multihost_traces")
+            traces_ok = None
+            compile_counts = None
+        else:
+            short_dir = os.path.join(root, "short")
+            short_pair = _mh_run_pair(data, config_short, short_dir)
+            if any(rc != 0 for rc, _ in short_pair):
+                raise RuntimeError("multihost short pair failed")
+            compile_counts = {
+                "long": [_mh_last_json(p)["compile_count"]
+                         for _, p in pair],
+                "short": [_mh_last_json(p)["compile_count"]
+                          for _, p in short_pair],
+            }
+            traces_ok = compile_counts["long"] == compile_counts["short"]
+
+        # -- leg 4: lost-worker containment + bit-exact resume
+        if max_wall is not None and \
+                time.perf_counter() - suite_t0 > max_wall:
+            truncated.append("multihost_kill_resume")
+            kill = None
+        else:
+            kout = os.path.join(root, "kill")
+            os.makedirs(kout, exist_ok=True)
+            port = _mh_free_port()
+            w0 = _mh_spawn(data, config, kout, devices=1,
+                           coordinator=f"localhost:{port}",
+                           num_processes=2, process_id=0)
+            w1 = _mh_spawn(data, config, kout, devices=1,
+                           coordinator=f"localhost:{port}",
+                           num_processes=2, process_id=1)
+            state = os.path.join(kout, "ckpt", "state.json")
+            deadline = time.time() + 240
+            while not os.path.exists(state) and time.time() < deadline:
+                time.sleep(0.1)
+            os.kill(w1.pid, signal.SIGKILL)
+            _mh_finish(w1)
+            survivor_rc = _mh_finish(w0)
+            payload = _mh_last_json(w0._mh_out_path)
+            rproc = _mh_spawn(data, config, kout, devices=2)
+            resume_rc = _mh_finish(rproc)
+            resumed = _mh_last_json(rproc._mh_out_path)
+            reference = _mh_last_json(rp._mh_out_path)
+            mk = _mh_model_bytes(kout)
+            kill = {
+                "survivor_rc": survivor_rc,
+                "survivor_rc_ok": survivor_rc == 75,
+                "lost_worker": payload.get("lost_worker"),
+                "resume_rc": resume_rc,
+                "resumed_from_iteration": resumed.get(
+                    "checkpoint_recovery", {}).get(
+                        "resumed_from_iteration"),
+                "final_objective_bit_equal": (
+                    resumed.get("final_objective")
+                    == reference.get("final_objective")),
+                "model_bit_identical": bool(mk) and mk == m1,
+            }
+            kill["resume_ok"] = (kill["survivor_rc_ok"]
+                                 and resume_rc == 0
+                                 and kill["final_objective_bit_equal"]
+                                 and kill["model_bit_identical"])
+
+        detail.update({
+            "parity_gap_abs": parity_gap,
+            "parity_ok": parity_gap <= 1e-8,
+            "model_bit_identical": model_bit_identical,
+            "cold_bytes_per_process": cold,
+            "warm_bytes_per_process": warm,
+            "warm_per_iter_bound_bytes": warm_bound,
+            "staging_ok": staging_ok,
+            "compile_counts": compile_counts,
+            "zero_fresh_traces_ok": traces_ok,
+            "kill_resume": kill,
+            "two_process_wall_s": round(pair_wall, 3),
+            "one_process_wall_s": round(ref_wall, 3),
+            "gates_green": bool(
+                parity_gap <= 1e-8 and model_bit_identical and staging_ok
+                and (traces_ok is not False)
+                and (kill is None or kill["resume_ok"])),
+        })
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    result = {
+        "metric": "multihost_vs_single_process_objective_gap",
+        "value": detail.get("parity_gap_abs"),
+        "unit": "abs",
+        "detail": detail,
+    }
+    if truncated:
+        detail["truncated"] = truncated
+        detail["max_wall_s"] = max_wall
+    _embed_telemetry(result)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def smoke_bench(out_path="BENCH_smoke.json"):
     """One tiny GLM solve + one tiny strict-vs-pipelined GAME pair: the
     bench harness end-to-end in seconds, CPU-safe, no scipy/f64 reference
@@ -6726,6 +7016,14 @@ def _dispatch():
                  and (i == 0 or rest[i - 1] != "--max-wall")]
         trace_bench(*(paths[:1] or ["BENCH_trace.json"]), smoke=smoke,
                     max_wall=_parse_max_wall(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--multihost":
+        smoke = "--smoke" in sys.argv[2:]
+        rest = sys.argv[2:]
+        paths = [a for i, a in enumerate(rest) if not a.startswith("--")
+                 and (i == 0 or rest[i - 1] != "--max-wall")]
+        multihost_bench(*(paths[:1] or ["BENCH_multihost.json"]),
+                        smoke=smoke,
+                        max_wall=_parse_max_wall(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         smoke_bench(*sys.argv[2:3])
     else:

@@ -37,6 +37,18 @@ DURABLE_MODULE_SUFFIXES = (
 )
 DURABLE_IMPL_SUFFIX = "utils/durable.py"
 
+#: path suffixes of modules whose code EVERY process of a multi-host run
+#: executes (PH014): durable writes / destructive mutations there must be
+#: lexically primary-guarded or annotated `# photonlint: all-process`.
+#: utils/durable.py itself is exempt (it IS the guard implementation).
+MULTIPROCESS_MODULE_SUFFIXES = (
+    "cli/train.py",
+    "game/coordinate_descent.py",
+    "parallel/multihost.py",
+    "data/streaming.py",
+    "ops/chunked.py",
+)
+
 _PRAGMA_RE = re.compile(
     r"#\s*photonlint:\s*(disable-file|disable|flush-point)"
     r"(?:\s*=\s*(PH[0-9]{3}(?:\s*,\s*PH[0-9]{3})*))?")
@@ -49,6 +61,11 @@ _PRAGMA_RE = re.compile(
 _GUARD_RE = re.compile(
     r"#\s*photonlint:\s*guarded-by\s*=\s*"
     r"(atomic|none|(?:self\.)?[A-Za-z_][A-Za-z0-9_]*)")
+
+#: multi-writer intent annotation (PH014): marks a write that is
+#: DELIBERATELY executed by every process (per-process heartbeat files,
+#: race-tolerant prune sweeps) — reviewable at the call site
+_ALL_PROCESS_RE = re.compile(r"#\s*photonlint:\s*all-process")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,7 +125,10 @@ class Suppressions:
         self.line_rules: Dict[int, Set[str]] = {}
         self.flush_lines: Set[int] = set()
         self.guard_lines: Dict[int, str] = {}   # lineno -> declared lock
+        self.all_process_lines: Set[int] = set()
         for lineno, text in enumerate(lines, start=1):
+            if _ALL_PROCESS_RE.search(text):
+                self.all_process_lines.add(lineno)
             g = _GUARD_RE.search(text)
             if g:
                 name = g.group(1)
@@ -228,6 +248,10 @@ class ModuleContext:
     @property
     def is_durable_impl(self) -> bool:
         return self.norm_path.endswith(DURABLE_IMPL_SUFFIX)
+
+    @property
+    def is_multiprocess_module(self) -> bool:
+        return self.norm_path.endswith(MULTIPROCESS_MODULE_SUFFIXES)
 
     # -- imports --------------------------------------------------------------
     def _scan_imports(self) -> None:

@@ -678,8 +678,180 @@ class EventRegistryRule(Rule):
         return findings
 
 
+# -- PH014: multi-writer discipline in multi-process modules ------------------
+
+class MultiprocessWriteRule(Rule):
+    """Every process of a multi-host run executes the modules listed in
+    `engine.MULTIPROCESS_MODULE_SUFFIXES` — an unguarded write there runs
+    P times against ONE path (torn summaries, racing prunes, doubled
+    registry entries).  The utils.durable helpers self-guard (no-op off
+    process 0 unless `all_process=True`), so they are compliant by
+    construction; everything that BYPASSES them must either sit under a
+    lexical primary guard (`multihost.is_primary()` /
+    `process_index() == 0`, including the early-return form) or carry a
+    `# photonlint: all-process` annotation declaring the multi-writer
+    intent (per-process files, race-tolerant sweeps).  A durable.* call
+    that passes `all_process=True` disables the helper's own guard, so it
+    needs the annotation too."""
+
+    rule_id = "PH014"
+    name = "multiprocess-write"
+    summary = ("multi-process-reachable modules: bare durable writes and "
+               "destructive mutations must be process-0-guarded "
+               "(multihost.is_primary() / process_index() == 0) or "
+               "annotated `# photonlint: all-process`; durable.* calls "
+               "passing all_process=True need the annotation as well")
+
+    _WRITE_MODES = ("w", "a", "x")
+    _DESTRUCTIVE = {"json.dump", "numpy.save", "numpy.savez",
+                    "numpy.savez_compressed", "shutil.rmtree",
+                    "shutil.copyfile", "shutil.move", "os.remove",
+                    "os.unlink", "os.replace", "os.rename"}
+    _DURABLE_PKG = "photon_ml_tpu.utils.durable."
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.is_multiprocess_module or ctx.is_durable_impl:
+            return []
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._classify(ctx, node)
+            if kind is None:
+                continue
+            if node.lineno in ctx.suppressions.all_process_lines:
+                continue
+            if self._primary_guarded(ctx, parents, node):
+                continue
+            if kind == "override":
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    "durable.* call passes all_process=True — that "
+                    "disables the helper's primary-only multi-writer "
+                    "guard, so EVERY process writes; annotate the line "
+                    "`# photonlint: all-process` to make the per-process "
+                    "intent reviewable (or drop the override)"))
+            else:
+                findings.append(ctx.finding(
+                    self.rule_id, node,
+                    f"unguarded {kind} in a multi-process module — every "
+                    "process executes this line against the same path; "
+                    "guard it with multihost.is_primary() (process 0 owns "
+                    "durable artifacts) or annotate `# photonlint: "
+                    "all-process` for a deliberately per-process / "
+                    "race-tolerant write"))
+        return findings
+
+    # -- classification -------------------------------------------------------
+    def _classify(self, ctx: ModuleContext, node: ast.Call) -> Optional[str]:
+        """'override' for durable.*(all_process=True), a description
+        string for a bare write/mutation, None for anything benign."""
+        if (isinstance(node.func, ast.Name) and node.func.id == "open"
+                and node.func.id not in ctx.names):
+            mode = None
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and mode.startswith(self._WRITE_MODES):
+                return f"open(..., {mode!r}) write"
+            return None
+        origin = ctx.resolve(node.func)
+        if origin is None:
+            return None
+        if origin in self._DESTRUCTIVE:
+            return f"{origin}() call"
+        if origin.startswith(self._DURABLE_PKG):
+            for kw in node.keywords:
+                if (kw.arg == "all_process"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    return "override"
+        return None
+
+    # -- lexical primary-guard resolution -------------------------------------
+    @staticmethod
+    def _callee_tail(n: ast.Call) -> str:
+        f = n.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+        return ""
+
+    def _primary_test(self, test: ast.AST) -> bool:
+        """True when `test` asserts this IS the primary process: an
+        is_primary() call anywhere in it, or process_index() == 0."""
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call) \
+                    and self._callee_tail(n) == "is_primary":
+                return True
+            if (isinstance(n, ast.Compare) and len(n.ops) == 1
+                    and isinstance(n.ops[0], ast.Eq)):
+                sides = [n.left] + n.comparators
+                if (any(isinstance(s, ast.Constant) and s.value == 0
+                        for s in sides)
+                        and any(isinstance(s, ast.Call)
+                                and self._callee_tail(s) == "process_index"
+                                for s in sides)):
+                    return True
+        return False
+
+    def _negated_primary_test(self, test: ast.AST) -> bool:
+        """True when `test` asserts this is NOT the primary:
+        `not is_primary()` / `process_index() != 0`."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._primary_test(test.operand)
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.NotEq)):
+            sides = [test.left] + test.comparators
+            return (any(isinstance(s, ast.Constant) and s.value == 0
+                        for s in sides)
+                    and any(isinstance(s, ast.Call)
+                            and self._callee_tail(s) == "process_index"
+                            for s in sides))
+        return False
+
+    def _primary_guarded(self, ctx: ModuleContext,
+                         parents: Dict[ast.AST, ast.AST],
+                         node: ast.AST) -> bool:
+        cur = node
+        while cur in parents:
+            par = parents[cur]
+            if isinstance(par, ast.If):
+                in_body = any(cur is s for s in par.body)
+                if in_body and self._primary_test(par.test):
+                    return True
+                # else-branch of an `if not primary:` split
+                if (not in_body and any(cur is s for s in par.orelse)
+                        and self._negated_primary_test(par.test)):
+                    return True
+            elif isinstance(par, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # early-return form: a preceding top-level statement of
+                # the function reads `if not is_primary(): return` —
+                # everything AFTER it is primary-only
+                for stmt in par.body:
+                    if stmt is cur:
+                        break
+                    if (isinstance(stmt, ast.If)
+                            and self._negated_primary_test(stmt.test)
+                            and all(isinstance(s, (ast.Return, ast.Raise))
+                                    for s in stmt.body)
+                            and not stmt.orelse):
+                        return True
+            cur = par
+        return False
+
+
 def all_rules() -> List[Rule]:
     from photon_ml_tpu.analysis.concurrency import concurrency_rules
     return [HostSyncRule(), RetraceHazardRule(), DonationSafetyRule(),
             FaultSiteRule(), DurableWriteRule(), NondeterminismRule(),
-            RawTimerRule(), EventRegistryRule()] + concurrency_rules()
+            RawTimerRule(), EventRegistryRule(),
+            MultiprocessWriteRule()] + concurrency_rules()

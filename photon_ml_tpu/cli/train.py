@@ -107,7 +107,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'auto' = all local devices on the data axis, 'none' "
                         "= single device, or 'DxF' (e.g. '4x2' = 4-way data "
                         "x 2-way feature sharding; F > 1 trains dense fixed "
-                        "effects on the feature-axis consensus-ADMM lane)")
+                        "effects on the feature-axis consensus-ADMM lane).  "
+                        "On a multi-process run the device list is GLOBAL "
+                        "(every host's devices, processes contiguous on the "
+                        "data axis)")
+    # multi-host bring-up (parallel/multihost.py): all three fall back to
+    # $PHOTON_COORDINATOR / $PHOTON_NUM_PROCESSES / $PHOTON_PROCESS_ID so
+    # pod launchers can export identity instead of templating argv
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host runs: process 0's coordination "
+                        "endpoint (jax.distributed); required when "
+                        "--num-processes > 1")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total processes in this run (1 = single-process, "
+                        "the default); a relaunch after a lost worker "
+                        "passes the SMALLER survivor count and resumes "
+                        "from --checkpoint-dir")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's id in [0, num-processes); process "
+                        "0 owns every durable write (checkpoints, models, "
+                        "summaries)")
     p.add_argument("--data-validation", default="full",
                    choices=["full", "sample", "disabled"],
                    help="input sanity-check intensity (reference: "
@@ -409,7 +428,13 @@ def main(argv=None) -> int:
     prev_level = pkg_logger.level
     pkg_logger.setLevel(logging.INFO)
     os.makedirs(args.output_dir, exist_ok=True)
-    _fh = logging.FileHandler(os.path.join(args.output_dir, "training.log"))
+    # multi-process runs share one output dir: each non-primary process
+    # logs to its own file so N writers never interleave one stream
+    from photon_ml_tpu.parallel import multihost
+    _pid = (args.process_id if args.process_id is not None
+            else multihost.process_index())
+    _log_name = "training.log" if _pid == 0 else f"training.proc{_pid}.log"
+    _fh = logging.FileHandler(os.path.join(args.output_dir, _log_name))
     _fh.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
     _fh.setLevel(logging.INFO)
     pkg_logger.addHandler(_fh)
@@ -430,6 +455,44 @@ def _run(args, log) -> int:
     import jax
     if args.x64:
         jax.config.update("jax_enable_x64", True)
+
+    # multi-host bring-up (parallel/multihost.py) — BEFORE anything touches
+    # jax devices: jax.distributed can only join a cluster on a fresh
+    # backend.  Identity falls back to $PHOTON_* env vars; a single-process
+    # invocation with none of the flags/env set skips all of this.
+    from photon_ml_tpu.parallel import multihost
+    watchdog = None
+    if (args.coordinator is not None or args.num_processes is not None
+            or args.process_id is not None
+            or os.environ.get(multihost.ENV_COORDINATOR)
+            or os.environ.get(multihost.ENV_NUM_PROCESSES)):
+        multihost.initialize(args.coordinator, args.num_processes,
+                             args.process_id)
+    if multihost.active():
+        if args.validation_data or args.tuning != "none":
+            raise SystemExit(
+                "--validation-data/--tuning are not supported on a "
+                "multi-process run yet: the validation plane scores with "
+                "process-LOCAL arrays, which cannot mix with the global "
+                "training placements.  Validate the saved model in a "
+                "separate single-process job.")
+        if args.mesh == "none":
+            raise SystemExit(
+                "--mesh none contradicts a multi-process run: without a "
+                "global mesh each process would train its own local copy")
+        watchdog = multihost.WorkerWatchdog(
+            args.output_dir,
+            interval_s=float(os.environ.get(
+                "PHOTON_HEARTBEAT_INTERVAL", 0.5)),
+            timeout_s=float(os.environ.get(
+                "PHOTON_HEARTBEAT_TIMEOUT", 10.0)),
+            escalate_s=float(os.environ.get(
+                "PHOTON_HEARTBEAT_ESCALATE", 10.0))).start()
+        multihost.set_watchdog(watchdog)
+        log.info("multihost: process %d/%d, watchdog armed "
+                 "(timeout %.1fs, escalate %.1fs)",
+                 multihost.process_index(), multihost.process_count(),
+                 watchdog.timeout_s, watchdog.escalate_s)
 
     # fault containment control plane (utils/faults.py): an env- or
     # flag-armed injection plan (chaos/testing runs), and SIGTERM/SIGINT
@@ -536,10 +599,11 @@ def _run(args, log) -> int:
         validate_game_dataset(val, task, args.data_validation,
                               check_weights=not args.no_weight_check)
 
-    if args.save_feature_stats:
+    if args.save_feature_stats and multihost.is_primary():
         # reference: cli/game/training/Driver.calculateAndSaveFeatureShardStats
         # (Driver.scala:297) — per-shard BasicStatisticalSummary persisted
-        # next to the job output
+        # next to the job output (process 0 only on a multi-process run:
+        # every process sees the same full host dataset)
         from photon_ml_tpu.data.stats import BasicStatisticalSummary
         stats_dir = os.path.join(args.output_dir, "feature-stats")
         os.makedirs(stats_dir, exist_ok=True)
@@ -691,9 +755,15 @@ def _run(args, log) -> int:
         from photon_ml_tpu.game.estimator import select_best_result
         best = select_best_result(results)
         os.makedirs(args.output_dir, exist_ok=True)
-        save_game_model(best.model, os.path.join(args.output_dir, "best"),
-                        config=best.config, index_maps=train.index_maps or None,
-                        format=args.model_format)
+        if multihost.is_primary():
+            # process 0 owns every durable artifact (photonlint PH014);
+            # peers trained the SAME model — GSPMD reductions leave the
+            # coefficients replicated — so one writer loses nothing
+            save_game_model(best.model,
+                            os.path.join(args.output_dir, "best"),
+                            config=best.config,
+                            index_maps=train.index_maps or None,
+                            format=args.model_format)
         # per-coordinate inner-solver accounting (SolveResult already
         # carried iterations + ConvergenceReason; the fit summary now
         # surfaces them instead of dropping them on the floor)
@@ -728,6 +798,11 @@ def _run(args, log) -> int:
             # coefficients/offsets, never the dataset)
             "mesh": dict(mesh.shape) if mesh is not None else None,
             "mesh_transfer": getattr(best, "mesh_transfer", None),
+            # multi-host accounting: identity + whether the mesh spans
+            # processes (mesh_transfer bytes above are PER-PROCESS there)
+            "multihost": ({"num_processes": multihost.process_count(),
+                           "process_id": multihost.process_index()}
+                          if multihost.active() else None),
             "host_blocked_s": round(
                 getattr(getattr(best.descent, "timings", None),
                         "host_blocked_total", lambda: 0.0)(), 3),
@@ -741,8 +816,10 @@ def _run(args, log) -> int:
             "trace_out": args.trace_out,
             "output": os.path.join(args.output_dir, "best"),
         }
-        with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
-            json.dump(summary, f, indent=2)
+        if multihost.is_primary():
+            with open(os.path.join(args.output_dir,
+                                   "training-summary.json"), "w") as f:
+                json.dump(summary, f, indent=2)
         log.info("summary: %s", summary)
         for coord, d in solver_diag.items():
             log.info("solver %-16s solves=%d iterations=%d reasons=%s "
@@ -781,14 +858,36 @@ def _run(args, log) -> int:
             "resumable": e.checkpointed,
             "checkpoint_dir": e.checkpoint_dir,
             "exit_status": faults.EXIT_PREEMPTED,
+            "lost_worker": (watchdog.lost_process
+                            if watchdog is not None else None),
             "wall_s": round(time.time() - t0, 2),
         }
         log.warning("preempted: %s", e)
-        with open(os.path.join(args.output_dir,
-                               "training-summary.json"), "w") as f:
-            json.dump(payload, f, indent=2)
+        if multihost.is_primary():
+            with open(os.path.join(args.output_dir,
+                                   "training-summary.json"), "w") as f:
+                json.dump(payload, f, indent=2)
         print(json.dumps(payload))
         return faults.EXIT_PREEMPTED
+    except Exception:
+        # a peer died mid-collective: gloo/XLA surface that as an opaque
+        # RuntimeError in the MAIN thread within milliseconds — typically
+        # BEFORE the watchdog's heartbeat timeout has elapsed — so poll
+        # the peer heartbeats synchronously to tell a dead peer apart
+        # from a genuine local crash.  With a confirmed loss this process
+        # is a SURVIVOR — exit with the resumable status 75 (checkpoint
+        # state is durable + manifest-consistent), not a crash.
+        lost = watchdog.confirm_lost() if watchdog is not None else None
+        if lost is not None:
+            log.error("multihost: collective failed after losing worker "
+                      "%d — exiting resumably (status %d)",
+                      lost, faults.EXIT_PREEMPTED, exc_info=True)
+            print(json.dumps({
+                "preempted": True, "resumable": True,
+                "lost_worker": lost,
+                "exit_status": faults.EXIT_PREEMPTED}))
+            return faults.EXIT_PREEMPTED
+        raise
     finally:
         preempt_guard.__exit__(None, None, None)
         if profile_ctx is not None:
@@ -810,6 +909,10 @@ def _run(args, log) -> int:
         # training/validation/tuning raises
         if emitter is not None:
             emitter.clear_listeners()
+        # multihost teardown LAST (stops the watchdog, leaves
+        # jax.distributed, resets identity) so an in-process caller can
+        # run again; idempotent no-op on single-process runs
+        multihost.shutdown()
 
 
 if __name__ == "__main__":

@@ -155,6 +155,18 @@ class GameDataset:
     def shard_dim(self, shard: str) -> int:
         return self.feature_shards[shard].shape[1]
 
+    def process_slice(self, count: int = None,
+                      index: int = None) -> "GameDataset":
+        """THIS process's contiguous 1/P row block of the dataset (count/
+        index default to the multihost runtime's identity) — the
+        process-slice view a multi-host ingest uses so each host holds only
+        the rows its mesh devices own.  Vocabularies and index maps are
+        SHARED with the parent (every process sees identical global entity
+        spaces, whatever rows it holds)."""
+        from photon_ml_tpu.parallel.multihost import process_row_range
+        r = process_row_range(self.num_rows, count=count, index=index)
+        return self.subset(np.arange(r.start, r.stop))
+
     def subset(self, rows: np.ndarray) -> "GameDataset":
         """Row slice sharing vocabularies (for train/validation splits)."""
         take = lambda a: None if a is None else a[rows]

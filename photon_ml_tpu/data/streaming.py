@@ -107,6 +107,23 @@ class ChunkPlan:
         """Device bytes of ONE full chunk (the double-buffer unit)."""
         return self.chunk_rows * bytes_per_row
 
+    def process_block(self, spec: ChunkSpec, *, num_shards: int,
+                      shard_lo: int, shard_hi: int) -> Tuple[int, int]:
+        """The process-slice view of one chunk: padded-row offsets [lo, hi)
+        of `spec` owned by data-axis shards [shard_lo, shard_hi) of
+        `num_shards`.  On a multi-process mesh each process's devices hold
+        a contiguous block of the data axis (parallel/mesh.py make_mesh),
+        so its share of every chunk is the contiguous padded-row block
+        returned here — the host then fetches/pads/transfers ONLY those
+        rows (1/P of the stream per process, zero cross-host movement)."""
+        if spec.padded_rows % num_shards:
+            raise ValueError(
+                f"chunk {spec.index} pads to {spec.padded_rows} rows, not a "
+                f"multiple of {num_shards} data-axis shards; build the plan "
+                "with row_multiple=num_shards")
+        per = spec.padded_rows // num_shards
+        return shard_lo * per, shard_hi * per
+
     @staticmethod
     def build(num_rows: int, *, chunk_rows: Optional[int] = None,
               hbm_budget_bytes: Optional[int] = None,
@@ -273,9 +290,16 @@ def _tree_device_put(host_tree):
 
 
 def _tree_nbytes(dev_tree) -> int:
+    """Bytes THIS process staged for a device chunk tree: on a
+    multi-process mesh each chunk is a global array of which this process
+    transferred only its addressable shards, so the accounting (and the
+    warm-bytes gates built on it) stays per-process."""
     import jax
-    return sum(getattr(leaf, "nbytes", 0)
-               for leaf in jax.tree_util.tree_leaves(dev_tree))
+
+    from photon_ml_tpu.parallel import multihost
+    return sum(multihost.local_nbytes(leaf)
+               for leaf in jax.tree_util.tree_leaves(dev_tree)
+               if leaf is not None)
 
 
 _DONE = object()
@@ -316,10 +340,13 @@ class Prefetcher:
         self.max_attempts = max_attempts
         self.backoff_s = backoff_s
         self.stats = stats if stats is not None else StreamStats()
-        # host pytree -> device placement; the default is an unsharded
-        # jnp.asarray transfer — mesh consumers (ops/chunked.py) pass a
-        # data-sharded device_put so each chunk lands split over the mesh
-        self._transfer = transfer if transfer is not None else _tree_device_put
+        # host pytree -> device placement, called as transfer(host, spec);
+        # the default is an unsharded jnp.asarray transfer — mesh consumers
+        # (ops/chunked.py) pass a data-sharded device_put so each chunk
+        # lands split over the mesh (and, multi-process, assembled from
+        # this process's row block alone)
+        self._transfer = (transfer if transfer is not None
+                          else lambda host, spec: _tree_device_put(host))
 
     def _stage_with_retry(self, spec: ChunkSpec, jitter: random.Random):
         """fetch + device transfer for one chunk, absorbing transient
@@ -331,7 +358,7 @@ class Prefetcher:
                 faults.fire("stage.fetch", chunk=spec.index)
                 host = self.fetch(spec)
                 faults.fire("stage.transfer", chunk=spec.index)
-                return self._transfer(host)
+                return self._transfer(host, spec)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:

@@ -346,6 +346,12 @@ def _write_checkpoint(directory: str, iteration: int, model: GameModel,
     import shutil
 
     from photon_ml_tpu.models.io import save_game_model
+    from photon_ml_tpu.parallel import multihost
+
+    # process 0 owns every durable artifact (multi-process callers pass
+    # checkpoint_dir=None off-primary, so this is defense in depth)
+    if not multihost.is_primary():
+        return
 
     faults.fire("checkpoint.write", iteration=iteration)
     try:
@@ -541,7 +547,8 @@ def _prune_stale_tmp(directory: str) -> List[str]:
             if fn.endswith(".tmp"):
                 p = os.path.join(root, fn)
                 try:
-                    os.remove(p)
+                    # every resuming process sweeps: race-tolerant
+                    os.remove(p)  # photonlint: all-process
                     pruned.append(p)
                 except OSError:
                     pass
@@ -577,7 +584,8 @@ def _prune_orphan_dirs(directory: str, keep: set) -> List[str]:
         ok, reason = verify_checkpoint_dir(p)
         if ok is True:
             continue
-        shutil.rmtree(p, ignore_errors=True)
+        # every resuming process sweeps: ignore_errors absorbs the race
+        shutil.rmtree(p, ignore_errors=True)  # photonlint: all-process
         pruned.append(p)
         logger.warning("checkpoint at %s: pruned orphaned partial write %s "
                        "(%s)", directory, p, reason)
@@ -829,10 +837,44 @@ def run_coordinate_descent(
     # observable per update.  Counters are host-side ints — snapshotting
     # them never syncs the device.
     _mesh_snap = None
+    _mh_mesh = None  # the mesh, when this run spans PROCESSES (multi-host)
     if any(getattr(getattr(c, "mesh", None), "size", 1) > 1
            for c in coordinates.values()):
         from photon_ml_tpu.parallel.mesh_residency import transfer_snapshot
         _mesh_snap = transfer_snapshot
+        from photon_ml_tpu.parallel import multihost
+        if multihost.active():
+            _mh_mesh = next(m for m in (getattr(c, "mesh", None)
+                                        for c in coordinates.values())
+                            if getattr(m, "size", 1) > 1)
+    if checkpoint_dir is not None:
+        from photon_ml_tpu.parallel import multihost as _mh
+        if not _mh.is_primary():
+            # multi-writer guard: every process runs this loop in lockstep,
+            # but exactly one may own the checkpoint directory (N processes
+            # racing the same state.json replace + manifest seal would
+            # corrupt it); non-primary processes train checkpoint-free and
+            # resume from process 0's records on relaunch
+            logger.info("multihost: process %d skips checkpoint writes "
+                        "(process 0 owns %s)", _mh.process_index(),
+                        checkpoint_dir)
+            checkpoint_dir = None
+
+    def _host_rows(a):
+        """[n] host vector -> device copy; on a multi-process mesh the copy
+        must be GLOBAL (data-sharded, assembled from per-process blocks) —
+        a local placement cannot feed a jit whose other operands span peer
+        processes' devices."""
+        if _mh_mesh is not None:
+            from photon_ml_tpu.parallel import multihost
+            return multihost.global_rows(_mh_mesh, np.asarray(a))
+        return jnp.asarray(a)
+
+    def _zero_rows(n):
+        if _mh_mesh is not None:
+            from photon_ml_tpu.parallel import multihost
+            return multihost.global_zeros(_mh_mesh, n)
+        return jnp.zeros(n)
 
     def _staged_delta(before):
         if before is None:
@@ -860,11 +902,12 @@ def run_coordinate_descent(
         return delta
     spans = PhaseTimings() if timings is None else timings
     with spans.span("init/transfer"):
-        labels = jnp.asarray(dataset.response)
+        labels = _host_rows(dataset.response)
         weights = (None if dataset.weights is None
-                   else jnp.asarray(dataset.weights))
-        base_offsets = (jnp.zeros(dataset.num_rows) if dataset.offsets is None
-                        else jnp.asarray(dataset.offsets))
+                   else _host_rows(dataset.weights))
+        base_offsets = (_zero_rows(dataset.num_rows)
+                        if dataset.offsets is None
+                        else _host_rows(dataset.offsets))
         spans.add_blocked("init/transfer",
                           _sync(labels, weights, base_offsets))
 
@@ -906,7 +949,7 @@ def run_coordinate_descent(
     # provided/resumed models are never overridden
     cold_factored: set = set()
     with spans.span("init/score"):
-        zeros = jnp.zeros(dataset.num_rows)
+        zeros = _zero_rows(dataset.num_rows)
         models, scores = {}, {}
         for name in updating_sequence:
             provided = (initial_models or {}).get(name)
@@ -961,6 +1004,9 @@ def run_coordinate_descent(
     val_labels_dev = val_weights_dev = val_offsets_dev = None
     if do_validation:
         with spans.span("init/validation_score"):
+            # the validation plane stays process-LOCAL on a multi-process
+            # run: score_dataset scores without the mesh (full per-process
+            # copies), so its arrays must not mix with global placements
             val_zeros = jnp.zeros(validation_dataset.num_rows)
             val_scores_by_coord = {
                 name: (val_zeros

@@ -381,6 +381,12 @@ class FixedEffectCoordinate:
             # update (vs n*d of streamed feature traffic per oracle pass),
             # then the whole solve is host-stepped over chunk streams
             from photon_ml_tpu.optim.streaming import solve_streamed
+            if not getattr(offsets, "is_fully_addressable", True):
+                # multi-process residual vector: all-gather to host first
+                # (a collective — safe because every process reaches this
+                # same point of the lockstep coordinate loop)
+                from photon_ml_tpu.parallel import multihost
+                offsets = multihost.host_gather(offsets)
             off_host = np.asarray(  # photonlint: disable=PH001 -- the documented ONE [n] readback per streamed update
                 offsets, dtype=self._canonical)
             obj = self._stream.replace(offsets=off_host)

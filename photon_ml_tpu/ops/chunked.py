@@ -145,6 +145,7 @@ class ChunkedGLMObjective:
             raise ValueError(f"plan covers {self.plan.num_rows} rows but the "
                              f"feature block has {self.x.shape[0]}")
         transfer = None
+        self._mh_shards = None  # (num_shards, shard_lo, shard_hi) multi-proc
         if self.mesh is not None and getattr(self.mesh, "size", 1) > 1:
             from photon_ml_tpu.parallel.mesh import DATA_AXIS
             data_axis = int(self.mesh.shape[DATA_AXIS])
@@ -154,17 +155,41 @@ class ChunkedGLMObjective:
                         f"chunk {spec.index} pads to {spec.padded_rows} rows, "
                         f"not a multiple of the mesh data axis {data_axis}; "
                         "build the ChunkPlan with row_multiple=data_axis")
+            from photon_ml_tpu.parallel import multihost
+            if multihost.active():
+                # process-slice streaming: each process fetches/stages only
+                # the contiguous data-axis block its own devices hold
+                # (make_mesh orders devices by process, so the block is
+                # contiguous by construction)
+                me = jax.process_index()
+                mine = [i for i in range(data_axis)
+                        if any(d.process_index == me
+                               for d in np.atleast_2d(self.mesh.devices)[i])]
+                if mine != list(range(mine[0], mine[-1] + 1)):
+                    raise ValueError(
+                        "this process's devices are not contiguous on the "
+                        "mesh data axis; build the mesh with "
+                        "parallel.make_mesh (process-sorted device order)")
+                self._mh_shards = (data_axis, mine[0], mine[-1] + 1)
             transfer = self._mesh_transfer
         self._prefetcher = Prefetcher(self.plan, self._fetch,
                                       depth=self.prefetch_depth,
                                       stats=self.stats, transfer=transfer)
 
-    def _mesh_transfer(self, host: dict) -> dict:
+    def _mesh_transfer(self, host: dict, spec: ChunkSpec) -> dict:
         """Chunk host pytree -> device, rows sharded over the mesh "data"
         axis (dtypes canonicalized exactly as the single-device
-        _tree_device_put would)."""
+        _tree_device_put would).  Multi-process: `host` holds only THIS
+        process's padded-row block of the chunk and the global array is
+        assembled from it — zero cross-host movement."""
+        from photon_ml_tpu.parallel import multihost
         from photon_ml_tpu.parallel.mesh import data_sharding
         canon = jax.dtypes.canonicalize_dtype
+        row_start = 0
+        if self._mh_shards is not None:
+            num, lo, hi = self._mh_shards
+            row_start, _ = self.plan.process_block(
+                spec, num_shards=num, shard_lo=lo, shard_hi=hi)
 
         def put(a):
             if a is None:
@@ -172,25 +197,42 @@ class ChunkedGLMObjective:
             a = np.asarray(a)
             if a.dtype != canon(a.dtype):
                 a = np.asarray(a, dtype=canon(a.dtype))
-            return jax.device_put(a, data_sharding(self.mesh, a.ndim))
+            sharding = data_sharding(self.mesh, a.ndim)
+            if self._mh_shards is None:
+                return jax.device_put(a, sharding)
+            return multihost.put_global_block(
+                self.mesh, a, sharding,
+                (spec.padded_rows,) + a.shape[1:], row_start)
 
         return jax.tree_util.tree_map(put, host,
                                       is_leaf=lambda a: a is None)
 
     # -- chunk staging (host side) -------------------------------------------
     def _fetch(self, spec: ChunkSpec) -> dict:
-        sl = slice(spec.start, spec.stop)
-        pr = spec.padded_rows
+        if self._mh_shards is not None:
+            num, shard_lo, shard_hi = self._mh_shards
+            lo, hi = self.plan.process_block(spec, num_shards=num,
+                                             shard_lo=shard_lo,
+                                             shard_hi=shard_hi)
+            # this process's global rows of the chunk (the tail block can
+            # be all padding: hi may exceed the chunk's real rows)
+            sl = slice(spec.start + lo, min(spec.stop, spec.start + hi))
+            pr = hi - lo
+            real = max(0, sl.stop - sl.start)
+        else:
+            sl = slice(spec.start, spec.stop)
+            pr = spec.padded_rows
+            real = spec.rows
         chunk = {"x": pad_rows_host(self.x[sl], pr, 0.0),
                  "labels": pad_rows_host(self.labels[sl], pr, _SAFE_LABEL)}
         chunk["weights"] = (None if self.weights is None
                             else pad_rows_host(self.weights[sl], pr, 0.0))
         chunk["offsets"] = (None if self.offsets is None
                             else pad_rows_host(self.offsets[sl], pr, 0.0))
-        if spec.rows == pr and self.mask is None:
+        if real == pr and self.mask is None:
             mask = np.ones(pr, self.x.dtype)
         else:
-            base = (np.ones(spec.rows, self.x.dtype) if self.mask is None
+            base = (np.ones(real, self.x.dtype) if self.mask is None
                     else self.mask[sl])
             mask = pad_rows_host(base, pr, 0.0)
         chunk["mask"] = mask
@@ -245,13 +287,23 @@ class ChunkedGLMObjective:
         """Margins X @ c as one streamed pass, returned as ONE device [n]
         array (the flat residual-score vectors stay device-resident in
         coordinate descent — only the feature block is out of core)."""
+        from photon_ml_tpu.parallel import multihost
         out = None
         for spec, ch in self._prefetcher.stream():
+            dev = _chunk_scores(ch["x"], c)
+            if self._mh_shards is not None:
+                # cross-process sharded chunk: all-gather to host (every
+                # process streams in lockstep, so the collective is safe)
+                dev = multihost.host_gather(dev)
             z = np.asarray(  # photonlint: disable=PH001 -- out-of-core scoring lands each chunk's [rows] margins on host by design
-                _chunk_scores(ch["x"], c))
+                dev)
             if out is None:
                 out = np.empty(self.plan.num_rows, z.dtype)
             out[spec.start:spec.stop] = z[:spec.rows]
+        if self._mh_shards is not None:
+            # scores feed the GLOBAL residual-score plane on a multi-process
+            # run: place them row-sharded like every other global array
+            return multihost.global_rows(self.mesh, out)
         return jnp.asarray(out)
 
     # -- stochastic local-solver lane (optim/stochastic.py) -------------------

@@ -61,8 +61,19 @@ def make_mesh(num_data: Optional[int] = None, num_feature: int = 1,
 
     Raises ValueError when the requested shape does not tile the device
     list exactly (the error names both, plus the inferred-`num_data` hint).
+
+    Process-aware: the default device list is ordered (process_index,
+    device id), so on a multi-process run each process's devices occupy a
+    CONTIGUOUS block of the data axis — each process then owns a contiguous
+    1/P row range of every data-sharded array, which is what lets the
+    per-host staging path (parallel/multihost.py) feed host-local row
+    blocks with zero cross-host movement.  Single-process this ordering is
+    the identity.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        devices = sorted(jax.devices(),
+                         key=lambda d: (d.process_index, d.id))
+    devices = list(devices)
     if num_data is None:
         num_data = len(devices) // num_feature
     if num_data * num_feature != len(devices):
@@ -82,6 +93,7 @@ def initialize_multihost(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     num_feature: int = 1,
+    timeout_s: float = 120.0,
 ) -> Mesh:
     """Join a multi-host run and return the global mesh — the role of the
     reference's cluster bring-up (SparkContextConfiguration.asYarnClient,
@@ -93,12 +105,20 @@ def initialize_multihost(
     list, so the returned mesh spans every host with "data" outermost:
     per-slice gradient psums ride ICI and cross DCN once per reduction
     (hierarchical, like the reference's treeAggregate depth-2).  All
-    arguments are optional on TPU pods, where they come from the
-    environment.
+    arguments fall back to the ``PHOTON_COORDINATOR`` /
+    ``PHOTON_NUM_PROCESSES`` / ``PHOTON_PROCESS_ID`` environment (pod
+    launchers), and on TPU pods jax's own cluster detection fills the rest.
+
+    Hardened bring-up (parallel/multihost.py): a second call with the same
+    parameters is an idempotent no-op, a mismatched re-init raises, a
+    worker that cannot reach the coordinator fails after `timeout_s` with
+    an error naming the coordinator address and process id, and
+    `photon_ml_tpu.parallel.multihost.shutdown()` (invoked from cli.train's
+    finally block) tears the run down cleanly.
     """
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    from photon_ml_tpu.parallel import multihost
+    multihost.initialize(coordinator_address, num_processes, process_id,
+                         timeout_s=timeout_s)
     return make_mesh(num_feature=num_feature)
 
 

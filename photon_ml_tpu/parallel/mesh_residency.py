@@ -43,6 +43,7 @@ byte split.
 """
 from __future__ import annotations
 
+import functools
 import random
 import threading
 from typing import Callable, Dict, Optional, Tuple
@@ -149,6 +150,11 @@ def _pad_axis0(a, rem: int, fill):
         out[a.shape[0]:] = fill
         return out
     a = jnp.asarray(a)
+    if not getattr(a, "is_fully_addressable", True):
+        # cross-process global array (multi-host residual offsets): pad
+        # inside a cached jitted program — eager concatenate with a
+        # locally-created fill block would mix local and global placements
+        return _global_padder(rem, a.ndim, float(fill))(a)
     sh = getattr(a, "sharding", None)
     if (getattr(sh, "mesh", None) is not None
             and sh.mesh.shape.get(FEATURE_AXIS, 1) > 1):
@@ -159,18 +165,51 @@ def _pad_axis0(a, rem: int, fill):
     return jnp.concatenate([a, jnp.full((rem,) + a.shape[1:], fill, a.dtype)])
 
 
+@functools.lru_cache(maxsize=None)
+def _global_padder(rem: int, ndim: int, fill: float):
+    pads = ((0, rem),) + ((0, 0),) * (ndim - 1)
+    return jax.jit(lambda x: jnp.pad(x, pads, constant_values=fill))
+
+
 def _put_leaf(mesh, leaf, spec: str):
     if leaf is None:
         return None
     if isinstance(leaf, np.ndarray):
         leaf = _canonical_np(leaf)
     if spec == "replicated" or np.ndim(leaf) == 0:
-        return jax.device_put(leaf, replicated(mesh))
-    if spec == "feature":
-        return jax.device_put(leaf, feature_sharding(mesh, np.ndim(leaf)))
-    if spec == "grid":
-        return jax.device_put(leaf, grid_sharding(mesh, np.ndim(leaf)))
-    return jax.device_put(leaf, data_sharding(mesh, np.ndim(leaf)))
+        sharding = replicated(mesh)
+    elif spec == "feature":
+        sharding = feature_sharding(mesh, np.ndim(leaf))
+    elif spec == "grid":
+        sharding = grid_sharding(mesh, np.ndim(leaf))
+    else:
+        sharding = data_sharding(mesh, np.ndim(leaf))
+    from photon_ml_tpu.parallel import multihost
+    if multihost.active():
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # already a global array (residual offsets computed on the
+            # mesh): resharding stays device-side — a host round-trip
+            # could not even read it back per-process
+            if leaf.sharding == sharding:
+                return leaf
+            return jax.jit(lambda a: a, out_shardings=sharding)(leaf)
+        # mesh spans processes: assemble the global array from per-device
+        # host slices (jax.make_array_from_single_device_arrays) — each
+        # process transfers ONLY the shards its devices own, zero
+        # cross-host movement at staging time.  Local jax arrays (padding
+        # leftovers, device-derived sources) are fully addressable and
+        # read back to host first.
+        return multihost.put_global(mesh, np.asarray(leaf), sharding)
+    return jax.device_put(leaf, sharding)
+
+
+def _leaf_nbytes(staged) -> int:
+    """Bytes accounted for one staged leaf: global `.nbytes` single-
+    process; on a multi-process mesh the PER-PROCESS share (addressable
+    shards, deduplicated — parallel/multihost.py), so the cold/warm gates
+    stay per-process as each host stages only its 1/P of rows."""
+    from photon_ml_tpu.parallel import multihost
+    return multihost.local_nbytes(staged)
 
 
 def _stage_tree(mesh, tree, fill, spec: str):
@@ -186,7 +225,7 @@ def _stage_tree(mesh, tree, fill, spec: str):
             rem = (-a.shape[0]) % mesh.shape[DATA_AXIS]
             a = _pad_axis0(a, rem, fill)
         staged = _put_leaf(mesh, a, spec)
-        return staged, int(staged.nbytes)
+        return staged, _leaf_nbytes(staged)
     # FeatureMatrix pytree (PaddedSparse / KroneckerDesign): pad via the
     # shared pad_rows, then shard every array leaf on its leading axis.
     # Row-shaped pytrees carry a .shape; others (NormalizationContext
@@ -197,7 +236,7 @@ def _stage_tree(mesh, tree, fill, spec: str):
         padded = fops.pad_rows(tree, rem)
     staged = jax.tree_util.tree_map(lambda l: _put_leaf(mesh, l, spec),
                                     padded)
-    nbytes = sum(int(l.nbytes) for l in jax.tree_util.tree_leaves(staged))
+    nbytes = sum(_leaf_nbytes(l) for l in jax.tree_util.tree_leaves(staged))
     return staged, nbytes
 
 
@@ -303,7 +342,7 @@ class MeshResidency:
                 out = build()
                 # surface async device failures inside the retry scope
                 jax.block_until_ready(out)
-            nbytes = sum(int(l.nbytes)
+            nbytes = sum(_leaf_nbytes(l)
                          for l in jax.tree_util.tree_leaves(out))
             self.stats.note_stage(nbytes, warm=False)
             return out

@@ -13,6 +13,16 @@ resume verifies it).
 This module is the designated implementation and is exempt from PH005;
 everything under models/io.py, game/coordinate_descent.py and
 data/index_map.py must route writes through here.
+
+Multi-process guard: on a multi-process run (parallel/multihost.py) every
+process executes the same training code, so every writer helper here would
+race the SAME ``state.json`` atomic replace from N processes — the rename
+itself is atomic, but interleaved replace/manifest/prune sequences from
+two writers can seal a manifest over a peer's half-pruned directory.  The
+helpers therefore no-op on non-primary processes (`process_index() != 0`);
+pass ``all_process=True`` for genuinely per-process files (the multihost
+heartbeat files — photonlint PH014 requires the call site to carry a
+``# photonlint: all-process`` annotation).
 """
 from __future__ import annotations
 
@@ -20,6 +30,14 @@ import hashlib
 import json
 import os
 from typing import Callable, Optional
+
+
+def _is_primary() -> bool:
+    # lazy import: multihost reads only module state/env (never jax), but
+    # keeping it out of import time lets lint tooling import this module
+    # standalone
+    from photon_ml_tpu.parallel import multihost
+    return multihost.is_primary()
 
 
 def fsync_file(path: str) -> None:
@@ -49,14 +67,17 @@ def file_sha256(path: str) -> str:
 
 
 def atomic_write_text(path: str, text: str, fsync: bool = True,
-                      before_replace: Optional[Callable[[], None]] = None
-                      ) -> None:
+                      before_replace: Optional[Callable[[], None]] = None,
+                      all_process: bool = False) -> None:
     """Write `text` to `path` via tmp+fsync+atomic-replace.  A crash at
     any point leaves either the old complete file or the new complete
     file, plus at worst a prunable `{path}.tmp`.  `before_replace` runs
     between the fsync and the rename — the hook checkpointing uses to
     place its `checkpoint.fsync` fault-injection site at the canonical
-    torn-write instant."""
+    torn-write instant.  No-op on non-primary processes unless
+    `all_process=True` (multi-writer guard, see module docstring)."""
+    if not all_process and not _is_primary():
+        return
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(text)
@@ -71,16 +92,20 @@ def atomic_write_text(path: str, text: str, fsync: bool = True,
 
 
 def atomic_write_json(path: str, obj, indent: int = 2, fsync: bool = True,
-                      before_replace: Optional[Callable[[], None]] = None
-                      ) -> None:
+                      before_replace: Optional[Callable[[], None]] = None,
+                      all_process: bool = False) -> None:
     atomic_write_text(path, json.dumps(obj, indent=indent),
-                      fsync=fsync, before_replace=before_replace)
+                      fsync=fsync, before_replace=before_replace,
+                      all_process=all_process)
 
 
-def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True,
+                       all_process: bool = False) -> None:
     """Binary twin of atomic_write_text (the tiered store's cold-segment
     spill path): tmp + fsync + atomic replace, so a crash mid-spill leaves
     either the old complete segment or the new complete segment."""
+    if not all_process and not _is_primary():
+        return
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
@@ -92,13 +117,16 @@ def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
         fsync_dir(os.path.dirname(path) or ".")
 
 
-def append_text(path: str, text: str, fsync: bool = True) -> None:
+def append_text(path: str, text: str, fsync: bool = True,
+                all_process: bool = False) -> None:
     """Durable append for record logs (the replication log's segment
     files).  Appends are not atomic the way replace is: a crash mid-append
     leaves a TORN TAIL, which is why every appended record must carry its
     own integrity check (fleet/replog.py checksums each line and truncates
     a torn tail on read).  The fsync makes every record that DID append
     completely survive the crash."""
+    if not all_process and not _is_primary():
+        return
     with open(path, "a") as f:
         f.write(text)
         f.flush()
@@ -106,10 +134,13 @@ def append_text(path: str, text: str, fsync: bool = True) -> None:
             os.fsync(f.fileno())
 
 
-def write_marker(path: str, fsync: bool = True) -> None:
+def write_marker(path: str, fsync: bool = True,
+                 all_process: bool = False) -> None:
     """Create an empty completion marker (`_SUCCESS`) durably: the marker
     must not become visible-and-durable before the data it vouches for,
     so the directory is fsynced after creation."""
+    if not all_process and not _is_primary():
+        return
     with open(path, "w"):
         pass
     if fsync:
@@ -117,11 +148,13 @@ def write_marker(path: str, fsync: bool = True) -> None:
         fsync_dir(os.path.dirname(path) or ".")
 
 
-def write_manifest(dirpath: str) -> None:
+def write_manifest(dirpath: str, all_process: bool = False) -> None:
     """Scan `dirpath` and write manifest.json LAST (tmp+rename+fsync):
     the completeness marker a checkpoint resume verifies.  Every data
     file is fsynced first so a verifying manifest implies durable
     contents."""
+    if not all_process and not _is_primary():
+        return
     files = {}
     for root, _, names in os.walk(dirpath):
         for fn in sorted(names):

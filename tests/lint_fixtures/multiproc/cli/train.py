@@ -1,0 +1,25 @@
+"""PH014 violation fixture: a multi-process-reachable module (path ends
+with cli/train.py) performing unguarded durable writes — every process of
+a multi-host run would execute each of these against the SAME path."""
+import json
+import os
+import shutil
+
+from photon_ml_tpu.utils import durable
+
+
+def write_summary(output_dir, summary):
+    # both the open(w) and the json.dump are unguarded multi-writer races
+    with open(os.path.join(output_dir, "training-summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+
+
+def prune_failed_run(path):
+    # destructive mutation with no primary guard: P processes racing rmtree
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def heartbeat(path, payload):
+    # all_process=True disables the helper's own primary guard — the
+    # per-process intent must be annotated `# photonlint: all-process`
+    durable.atomic_write_json(path, payload, all_process=True)

@@ -1,0 +1,43 @@
+"""PH014 compliant near-miss: the same writes as the violation fixture,
+each carrying one of the accepted disciplines — a lexical primary guard
+(direct, boolean-combined, process_index()==0, or early-return form), the
+`# photonlint: all-process` annotation, or the self-guarded durable.*
+helpers with their default primary-only behavior."""
+import json
+import os
+import shutil
+
+from photon_ml_tpu.parallel import multihost
+from photon_ml_tpu.utils import durable
+
+
+def write_summary(output_dir, summary):
+    if multihost.is_primary():
+        with open(os.path.join(output_dir,
+                               "training-summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+
+
+def write_stats(output_dir, enabled, payload):
+    # guard combined with an unrelated condition still counts
+    if enabled and multihost.process_index() == 0:
+        with open(os.path.join(output_dir, "stats.json"), "w") as f:
+            json.dump(payload, f)
+
+
+def prune_failed_run(path):
+    # early-return form: everything below is primary-only
+    if not multihost.is_primary():
+        return
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def heartbeat(path, payload):
+    # deliberately per-process file — annotated multi-writer intent
+    durable.atomic_write_json(  # photonlint: all-process
+        path, payload, all_process=True)
+
+
+def record(path, payload):
+    # durable.* default behavior self-guards (no-op off process 0)
+    durable.atomic_write_json(path, payload)

@@ -668,6 +668,38 @@ def test_store_smoke(tmp_path):
     assert traces["serving_exercised"] is True
 
 
+def test_multihost_smoke(tmp_path):
+    """bench.py --multihost --smoke (ISSUE 19 satellite): the 2-subprocess
+    jax.distributed pair on tiny shapes, with the parity + staging gates
+    asserted and the wall budget honored — an exhausted --max-wall skips
+    the trace-differential and kill/resume legs with explicit "truncated"
+    markers instead of blowing the suite budget."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_multihost.json"
+    result = bench.multihost_bench(str(out), smoke=True, max_wall=0.05)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    d = result["detail"]
+    # the 2proc x 1dev vs 1proc x 2dev pair keeps the global mesh, so
+    # parity is bit-exact, not approximate
+    assert d["parity_ok"] is True and d["parity_gap_abs"] == 0.0
+    assert d["model_bit_identical"] is True
+    # per-process staging: symmetric cold shards, bounded warm traffic
+    assert d["staging_ok"] is True
+    assert len(d["cold_bytes_per_process"]) == 2
+    # the wall budget was exhausted after the parity leg: the remaining
+    # legs are skipped WITH markers, and the skipped gates stay non-False
+    assert set(d["truncated"]) == {"multihost_traces",
+                                   "multihost_kill_resume"}
+    assert d["max_wall_s"] == 0.05
+    assert d["zero_fresh_traces_ok"] is None
+    assert d["kill_resume"] is None
+    assert d["gates_green"] is True
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a

@@ -36,6 +36,8 @@ CASES = [
      "hot/ops/ph007_compliant.py", 4),
     ("PH008", "telemetryreg/telemetry/flight.py",
      "telemetryreg_ok/telemetry/flight.py", 3),
+    ("PH014", "multiproc/cli/train.py",
+     "multiproc_ok/cli/train.py", 4),
     ("PH010", "concurrency/ph010_violation.py",
      "concurrency/ph010_compliant.py", 3),
     ("PH011", "concurrency/ph011_violation.py",

@@ -160,27 +160,65 @@ def test_initialize_multihost_topology(monkeypatch):
     mesh layout without real DCN — jax.distributed is faked, the global
     device list is the virtual 8-CPU set."""
     from photon_ml_tpu.parallel import mesh as mesh_mod
+    from photon_ml_tpu.parallel import multihost
+
+    # initialize_multihost records the run topology in multihost._STATE;
+    # restore every key afterwards so the fake 2-process run can't leak
+    # into later tests in this interpreter.
+    for k, v in multihost._STATE.items():
+        monkeypatch.setitem(multihost._STATE, k, v)
 
     calls = {}
 
     def fake_initialize(coordinator_address=None, num_processes=None,
-                        process_id=None):
+                        process_id=None, initialization_timeout=None):
         calls.update(coordinator_address=coordinator_address,
                      num_processes=num_processes, process_id=process_id)
 
     monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
-    m = mesh_mod.initialize_multihost(
-        coordinator_address="host0:1234", num_processes=2, process_id=0)
-    assert calls == {"coordinator_address": "host0:1234",
-                     "num_processes": 2, "process_id": 0}
-    # the mesh spans the GLOBAL device list, data axis outermost
-    assert m.axis_names == (mesh_mod.DATA_AXIS, mesh_mod.FEATURE_AXIS)
-    assert dict(m.shape) == {"data": 8, "feature": 1}
+    # Force CPU backend creation now: initialize_multihost flips the gloo
+    # collectives config, which only a REAL distributed client can satisfy —
+    # with jax.distributed faked, a fresh backend would fail to build.
+    jax.devices()
+    holder = jax.config._value_holders["jax_cpu_collectives_implementation"]
+    prev_collectives = holder.value
+    try:
+        m = mesh_mod.initialize_multihost(
+            coordinator_address="host0:1234", num_processes=2, process_id=0)
+        assert calls == {"coordinator_address": "host0:1234",
+                         "num_processes": 2, "process_id": 0}
+        # the mesh spans the GLOBAL device list, data axis outermost
+        assert m.axis_names == (mesh_mod.DATA_AXIS, mesh_mod.FEATURE_AXIS)
+        assert dict(m.shape) == {"data": 8, "feature": 1}
 
-    m2 = mesh_mod.initialize_multihost(num_feature=2)
-    assert dict(m2.shape) == {"data": 4, "feature": 2}
-    # pod-style bring-up: every argument defaults to the environment
-    assert calls["coordinator_address"] is None
+        # hardened bring-up: a same-topology re-init is an idempotent no-op
+        # (jax.distributed is NOT re-entered)...
+        calls.clear()
+        m2 = mesh_mod.initialize_multihost(
+            coordinator_address="host0:1234", num_processes=2, process_id=0,
+            num_feature=2)
+        assert dict(m2.shape) == {"data": 4, "feature": 2}
+        assert calls == {}
+        # ...while a mismatched topology is refused outright
+        with pytest.raises(multihost.MultihostInitError):
+            mesh_mod.initialize_multihost(
+                coordinator_address="other:9", num_processes=4, process_id=1)
+
+        # pod-style bring-up: every argument falls back to the PHOTON_*
+        # environment
+        multihost._STATE.update(declared=False, initialized=False,
+                                coordinator=None, num_processes=None,
+                                process_id=None)
+        monkeypatch.setenv(multihost.ENV_COORDINATOR, "env-host:4321")
+        monkeypatch.setenv(multihost.ENV_NUM_PROCESSES, "2")
+        monkeypatch.setenv(multihost.ENV_PROCESS_ID, "1")
+        calls.clear()
+        mesh_mod.initialize_multihost()
+        assert calls == {"coordinator_address": "env-host:4321",
+                         "num_processes": 2, "process_id": 1}
+    finally:
+        jax.config.update("jax_cpu_collectives_implementation",
+                          prev_collectives)
 
 
 def test_initialize_multihost_rejects_bad_factorization(monkeypatch):
