@@ -72,6 +72,19 @@ serving"):
       publisher replica, GET /fleet/audit fans out to every replica,
       POST /fleet/drain {"replica": URL} drains one replica out of
       rotation.
+  --replica --shard K/N   (entity-sharded serving — COMPONENTS.md
+      "Entity-sharded serving")
+      this replica holds ONLY shard K of an N-way deterministic
+      partition of the random-effect entity space (FE/MF replicate in
+      full; replicated deltas filter to owned rows; tiered-store
+      residency is sized to the slice).  POST /margins serves one
+      fan-out leg; the publisher declares the partition with
+      --shard-count N (a shard_map record anchors the log), and a front
+      over sharded replicas fans /score //predict out per shard and
+      re-folds bit-identically, degrading per --degraded-policy when a
+      shard has no healthy replica.  GET /fleet/audit?shard=K on the
+      publisher returns the full model filtered to shard K — equal
+      hashes to a converged shard-K replica's own audit.
 
 Fleet observability (COMPONENTS.md "Fleet observability"): --trace-out /
 --run-log arm the span tracer in EVERY mode (front/replica/publish
@@ -187,6 +200,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "— the crash/catch-up resume point)")
     p.add_argument("--replica-poll-ms", type=float, default=50.0,
                    help="log tail poll period of the replica apply loop")
+    # -- fleet: entity sharding (fleet/shards.py) ---------------------------
+    p.add_argument("--shard", default=None, metavar="K/N",
+                   help="entity-sharded replica: hold only shard K of an "
+                        "N-way partition of the random-effect entity "
+                        "space (K in [0,N); fixed-effect/MF coordinates "
+                        "replicate in full; replicated deltas filter to "
+                        "owned rows; /margins serves fan-out legs)")
+    p.add_argument("--shard-count", type=int, default=None, metavar="N",
+                   help="publisher: declare the fleet's N-way entity "
+                        "partition — anchors a shard_map record on the "
+                        "replication log so joining replicas validate "
+                        "their --shard against it (the publisher itself "
+                        "stays unsharded)")
+    p.add_argument("--shard-salt", default="photon",
+                   help="shard-map hash salt (must match fleet-wide)")
+    p.add_argument("--shard-spec-version", type=int, default=1,
+                   help="shard-map version; a rebalance rolls out by "
+                        "bumping it fleet-wide (the front adopts the "
+                        "highest version it probes)")
     # -- fleet: front mode --------------------------------------------------
     p.add_argument("--front", action="store_true",
                    help="run the model-free routing front over "
@@ -206,6 +238,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-inflight", type=int, default=256,
                    help="front: concurrently routed requests before "
                         "shedding (429)")
+    p.add_argument("--degraded-policy", choices=("partial", "error"),
+                   default="partial",
+                   help="front, sharded fleets: what scoring gets when a "
+                        "touched shard has no healthy replica — "
+                        "'partial' folds the lost contributions as 0.0 "
+                        "and stamps the response degraded, 'error' "
+                        "fails those requests 503")
     # -- fleet observability (telemetry/distributed + telemetry/flight) -----
     p.add_argument("--trace-out", default=None, metavar="TRACE.json",
                    help="arm the telemetry span tracer and write a Chrome-"
@@ -249,6 +288,9 @@ def _build_service(args):
         emitter = EventEmitter()
         for dotted in args.event_listener:
             emitter.register_listener_class(dotted)
+    shard_index = shard_count = None
+    if getattr(args, "shard", None):
+        shard_index, shard_count = _parse_shard(args.shard)
     cfg = ServingConfig(
         max_wait_s=args.max_wait_ms / 1e3,
         max_batch=args.max_batch,
@@ -260,7 +302,11 @@ def _build_service(args):
         store_budget_rows=args.store_budget_rows,
         store_dir=args.store_dir,
         store_warm_segments=args.store_warm_segments,
-        store_seg_rows=args.store_seg_rows)
+        store_seg_rows=args.store_seg_rows,
+        shard_index=shard_index,
+        shard_count=shard_count,
+        shard_salt=getattr(args, "shard_salt", "photon"),
+        shard_version=getattr(args, "shard_spec_version", 1))
     updates = None
     if args.enable_updates:
         from photon_ml_tpu.online import OnlineUpdateConfig
@@ -282,6 +328,19 @@ def _build_service(args):
     return ScoringService(model_dir=args.model_dir, config=cfg,
                           emitter=emitter, updates=updates, health=health,
                           start_updater=start_updater)
+
+
+def _parse_shard(text: str):
+    """--shard "K/N" -> (index, count)."""
+    try:
+        k, _, n = text.partition("/")
+        index, count = int(k), int(n)
+    except ValueError:
+        raise SystemExit(f"--shard expects K/N (e.g. 0/4), got {text!r}")
+    if not 0 <= index < count:
+        raise SystemExit(f"--shard index {index} out of range for "
+                         f"{count} shards")
+    return index, count
 
 
 def _dump_metrics(service, stream=sys.stderr):
@@ -389,6 +448,7 @@ def _make_http_server(service, host: str, port: int, replica=None,
 
     import numpy as np
 
+    from photon_ml_tpu.fleet.replog import encode_array
     from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
     from photon_ml_tpu.telemetry import distributed, flight
 
@@ -462,8 +522,20 @@ def _make_http_server(service, host: str, port: int, replica=None,
                 # probe takes the replica out without parsing the body
                 self._reply(200 if payload["status"] == "ok" else 503,
                             payload)
-            elif self.path == "/fleet/audit":
-                if replica is not None:
+            elif self.path.split("?", 1)[0] == "/fleet/audit":
+                from urllib.parse import parse_qs, urlsplit
+                q = parse_qs(urlsplit(self.path).query)
+                if q.get("shard") and publisher is not None:
+                    # the publisher-side half of a per-shard audit: its
+                    # FULL tables filtered to shard K's owned rows — a
+                    # converged shard-K replica's plain audit reports
+                    # the identical sha256 hashes
+                    try:
+                        self._reply(200, publisher.shard_audit(
+                            int(q["shard"][0])))
+                    except ValueError as e:
+                        self._reply(400, {"error": str(e)})
+                elif replica is not None:
                     self._reply(200, replica.audit())
                 else:
                     audit = service.audit()
@@ -506,6 +578,25 @@ def _make_http_server(service, host: str, port: int, replica=None,
                             key = "predictions"
                     self._reply(200, {key: np.asarray(out).tolist(),
                                       "model_version": service.model_version})
+                elif self.path == "/margins":
+                    # one leg of an entity-sharded fan-out (fronts call
+                    # this; fleet/shards.merge_margins re-folds the
+                    # legs).  Margins travel as encode_array payloads —
+                    # exact dtype + bytes, since the merge's bit-identity
+                    # depends on folding the device compute dtype, not a
+                    # JSON float round-trip
+                    with distributed.server_span("serve_request",
+                                                 self.headers,
+                                                 path=self.path):
+                        feats = {s: np.asarray(v, np.float64)
+                                 for s, v in (req.get("features")
+                                              or {}).items()}
+                        ids = {t: np.asarray(v, dtype=object)
+                               for t, v in (req.get("ids") or {}).items()}
+                        out = service.score_margins(feats, ids)
+                    out["margins"] = {name: encode_array(m)
+                                      for name, m in out["margins"].items()}
+                    self._reply(200, out)
                 elif self.path == "/feedback":
                     if follower:
                         return self._reply(403, {
@@ -652,7 +743,13 @@ def _make_front_server(front, host: str, port: int):
                 self._reply(200, front.federated_snapshot())
             elif self.path == "/healthz":
                 status = front.status()
-                ok = status["ready_replicas"] > 0
+                # sharded fleets: the front is healthy only while EVERY
+                # shard has a healthy replica — a dark shard means part
+                # of the entity space cannot be scored exactly, and a
+                # stock load balancer should see that without parsing
+                shards_down = (status.get("shards") or {}).get(
+                    "shards_down") or []
+                ok = status["ready_replicas"] > 0 and not shards_down
                 status["status"] = "ok" if ok else "degraded"
                 status["telemetry"] = distributed.clock_info()
                 self._reply(200 if ok else 503, status)
@@ -756,7 +853,8 @@ def _run_front(args) -> int:
             probe_interval_s=args.probe_interval_ms / 1e3,
             hedge_after_s=args.hedge_ms / 1e3,
             request_timeout_s=args.front_timeout_ms / 1e3,
-            max_inflight=args.max_inflight))
+            max_inflight=args.max_inflight,
+            degraded_policy=args.degraded_policy))
     front.probe_once()  # populate readiness before the first request
     httpd = _make_front_server(front, args.host, args.port)
     print(json.dumps({
@@ -764,6 +862,7 @@ def _run_front(args) -> int:
         "mode": "front",
         "replicas": args.replica_url,
         "publisher": args.publisher_url or args.replica_url[0],
+        "degraded_policy": args.degraded_policy,
         "endpoints": ["/score", "/predict", "/feedback", "/metrics",
                       "/metrics/front", "/metrics.json", "/swap",
                       "/rollback", "/healthz", "/fleet/audit",
@@ -799,6 +898,18 @@ def main(argv=None) -> int:
                              "updater (--enable-updates needs --publish): "
                              "model state enters the fleet through the "
                              "replication log")
+        if args.shard and args.publish:
+            raise SystemExit("the publisher stays unsharded (it holds "
+                             "the full model); declare the fleet's "
+                             "partition with --shard-count instead")
+        if args.shard and args.enable_updates:
+            raise SystemExit("a sharded replica cannot run the online "
+                             "updater: deltas are solved on the "
+                             "publisher and replicate shard-filtered")
+        if args.shard_count is not None and not args.publish:
+            raise SystemExit("--shard-count is the publisher's flag "
+                             "(--replica --publish); shard replicas "
+                             "take --shard K/N")
     _arm_observability(args, proc_label(args))
     from photon_ml_tpu.telemetry import flight
     try:
@@ -840,8 +951,15 @@ def _run_serve(args) -> int:
                                          ReplicaConfig, ReplicationLog)
         log = ReplicationLog(args.replication_log)
         if args.publish:
+            shard_spec = None
+            if args.shard_count is not None:
+                from photon_ml_tpu.fleet import ShardSpec
+                shard_spec = ShardSpec(num_shards=args.shard_count,
+                                       salt=args.shard_salt,
+                                       version=args.shard_spec_version)
             publisher = FleetPublisher(service, log,
-                                       model_dir=args.model_dir)
+                                       model_dir=args.model_dir,
+                                       shard_spec=shard_spec)
             if service.updater is not None:
                 # started HERE, after the publish hook attached: no delta
                 # may ever land unreplicated
@@ -866,10 +984,12 @@ def _run_serve(args) -> int:
         "buckets": service.registry.scorer.bucket_sizes(),
         "updates_enabled": service.updater is not None,
         "health_enabled": service.health is not None,
+        "shard": service.registry.scorer.shard_info(),
+        "shard_count_published": args.shard_count,
         "join": join_info,
-        "endpoints": ["/score", "/predict", "/feedback", "/metrics",
-                      "/metrics.json", "/swap", "/rollback", "/healthz",
-                      "/flight/dump"]
+        "endpoints": ["/score", "/predict", "/margins", "/feedback",
+                      "/metrics", "/metrics.json", "/swap", "/rollback",
+                      "/healthz", "/flight/dump"]
         + (["/fleet/audit", "/fleet/drain"] if args.replica else []),
     }), flush=True)
     try:

@@ -10,6 +10,13 @@ through its own ModelRegistry and converges to bit-identical tables
 routes scoring traffic across the ready replicas with failover, hedging,
 draining and explicit backpressure.  See COMPONENTS.md "Replicated
 serving" for the log format and the convergence argument.
+
+Entity-sharded serving (fleet/shards.py) partitions the random-effect
+entity space across replicas: a versioned ShardSpec (carried on the log
+as a shard_map record) deterministically assigns every entity id to a
+shard, sharded replicas hold only their owned slice, and the front fans
+scoring out per shard and re-folds margins bit-identically.  See
+COMPONENTS.md "Entity-sharded serving".
 """
 from photon_ml_tpu.fleet.front import (FRONT_SNAPSHOT_PATHS,  # noqa: F401
                                        Front, FrontConfig,
@@ -23,4 +30,8 @@ from photon_ml_tpu.fleet.replog import (FeedbackLog,  # noqa: F401
                                         delta_from_record, encode_array,
                                         feedback_from_record,
                                         record_for_event,
-                                        record_for_feedback)
+                                        record_for_feedback,
+                                        record_for_shard_map)
+from photon_ml_tpu.fleet.shards import (ShardAssignment,  # noqa: F401
+                                        ShardMergeError, ShardSpec,
+                                        merge_margins, shards_touched)

@@ -27,6 +27,17 @@ replica URL (the serve HTTP protocol IS the replica protocol) and:
   * DRAINS   `drain(url)` stops routing to a replica, tells it to drain
              (its own /healthz flips 503 for any other front), waits for
              in-flight requests to finish, then detaches it.
+  * SHARDS   when probed replicas declare entity-shard ownership
+             (serve --shard K/N), scoring fans out as per-shard /margins
+             legs — each leg hedged and failed over WITHIN its shard
+             group — and the front re-folds the per-coordinate margins
+             bit-identically to a monolithic replica
+             (fleet/shards.merge_margins).  A shard with zero healthy
+             replicas degrades ONLY requests touching its entities:
+             `degraded_policy="partial"` folds the lost contributions as
+             exactly 0.0 and stamps the response degraded,
+             `"error"` fails those requests 503.  Losing a shard's last
+             replica fires the shard.lost flight trigger fleet-wide.
 
 The front's routing metrics live on its OWN MetricsRegistry (the
 ServingMetrics fleet.* family is the replica-side surface): request /
@@ -42,12 +53,17 @@ from http.client import HTTPConnection
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
+import numpy as np
+
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.telemetry import distributed, flight
 from photon_ml_tpu.telemetry.export import prometheus_text
 from photon_ml_tpu.telemetry.metrics import MetricsRegistry
+from photon_ml_tpu.fleet.replog import decode_array
+from photon_ml_tpu.fleet.shards import (ShardMergeError, ShardSpec,
+                                        merge_margins, shards_touched)
 from photon_ml_tpu.serving.batcher import Overloaded, ServingError
-from photon_ml_tpu.utils import locktrace
+from photon_ml_tpu.utils import faults, locktrace
 
 import dataclasses
 import logging
@@ -75,6 +91,9 @@ FRONT_SNAPSHOT_PATHS = {
     "fleet.front_ready_replicas": ("ready_replicas",),
     "fleet.front_max_lag_seq": ("max_lag_seq",),
     "front.requests": ("requests_by_replica",),
+    "fleet.shard_requests": ("shard_requests",),
+    "fleet.shard_coverage": ("shard_coverage",),
+    "fleet.shard_degraded": ("shard_degraded",),
 }
 
 
@@ -95,6 +114,13 @@ class FrontConfig:
     hedge_after_s: float = 0.25     # pending this long -> hedge a twin
     max_attempts: int = 3           # total sends per request (incl. hedges)
     max_inflight: int = 256         # routed concurrently before shedding
+    # entity-sharded fleets: what a scoring request gets when a shard it
+    # touches has NO healthy replica.  "partial": the lost shard's
+    # random-effect contributions fold as exactly 0.0 (the unseen-entity
+    # default) and the response is stamped degraded=true with the
+    # affected rows; "error": the request fails 503 — correctness over
+    # availability
+    degraded_policy: str = "partial"
 
 
 class ReplicaHandle:
@@ -114,12 +140,15 @@ class ReplicaHandle:
         self.inflight = 0
         self.applied_seq: Optional[int] = None
         self.last_error: Optional[str] = None
+        # which entity shard this replica owns — learned from its probed
+        # /healthz payload, never from static config (None: full model)
+        self.shard: Optional[int] = None
 
     def state(self) -> Dict[str, object]:
         return {"url": self.url, "publisher": self.publisher,
                 "ready": self.ready, "draining": self.draining,
                 "detached": self.detached, "inflight": self.inflight,
-                "applied_seq": self.applied_seq,
+                "applied_seq": self.applied_seq, "shard": self.shard,
                 "last_error": self.last_error}
 
 
@@ -134,6 +163,10 @@ class Front:
         tests and the bench."""
         if not replica_urls:
             raise ValueError("a front needs at least one replica URL")
+        if config.degraded_policy not in ("partial", "error"):
+            raise ValueError(f"unknown degraded_policy "
+                             f"{config.degraded_policy!r} "
+                             "(choose 'partial' or 'error')")
         self.config = config
         self._lock = locktrace.tracked(threading.Lock(), "Front._lock")
         publisher_url = (publisher_url or replica_urls[0]).rstrip("/")
@@ -159,9 +192,30 @@ class Front:
         # failed over, shed, or was abandoned as a hedge loser
         self._m_by_replica = r.labeled_counter("front.requests",
                                                ("replica", "outcome"))
+        # entity-sharded fleets: per-(shard, outcome) leg accounting, the
+        # minimum per-shard healthy-replica count (-1: fleet unsharded;
+        # 0: some shard is DARK — alert on this), and requests answered
+        # degraded because a touched shard was dark
+        self._m_shard_requests = r.labeled_counter("fleet.shard_requests",
+                                                   ("shard", "outcome"))
+        self._m_shard_coverage = r.gauge("fleet.shard_coverage")
+        self._m_shard_coverage.set(-1.0)
+        self._m_shard_degraded = r.counter("fleet.shard_degraded")
+        # the fleet partition, adopted from probed replicas (highest spec
+        # version wins; replicas on another spec_id leave rotation), and
+        # the coordinate fold order cached off the last merged response
+        self._shard_spec: Optional[ShardSpec] = None  # photonlint: guarded-by=_lock
+        self._coord_meta: Optional[List[dict]] = None  # photonlint: guarded-by=_lock
+        self._lost_shards: set = set()                # photonlint: guarded-by=_lock
+        self._seen_shards: set = set()                # photonlint: guarded-by=_lock
         self._pool = ThreadPoolExecutor(
             max_workers=max(8, min(config.max_inflight, 64)),
             thread_name_prefix="photon-front")
+        # shard-leg coordinators get their OWN small pool: a leg blocks
+        # waiting on sends it submits to _pool, so running coordinators
+        # there too could deadlock the pool against itself under load
+        self._leg_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="photon-front-shard")
         self._closed = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None  # photonlint: guarded-by=_lock
         if start_probes:
@@ -187,6 +241,14 @@ class Front:
                 err = None if ok else f"healthz {status}"
             except Exception as e:
                 err = f"{type(e).__name__}: {e}"
+            if ok:
+                # entity-sharded fleets: the replica's /healthz declares
+                # which shard it owns; a replica on an incompatible
+                # partition is treated as UNHEALTHY (routing margins from
+                # a different partition would merge wrong rows)
+                shard_err = self._note_shard_payload(payload)
+                if shard_err is not None:
+                    ok, err = False, shard_err
             # every health probe doubles as an NTP-style clock probe: the
             # replica's /healthz carries its wall clock, and the minimum-
             # RTT offset estimate is what `cli.trace merge` aligns the
@@ -211,6 +273,8 @@ class Front:
                     fleet = (payload or {}).get("fleet") or {}
                     if fleet.get("applied_seq") is not None:
                         h.applied_seq = int(fleet["applied_seq"])
+                    sh = (payload or {}).get("shard")
+                    h.shard = int(sh["index"]) if sh else None
                 else:
                     h.fails += 1
                     h.successes = 0
@@ -235,7 +299,75 @@ class Front:
                     self._flight_fleet_dump("replica.unhealthy",
                                             url=h.url, error=str(err))
         self._refresh_gauges()
+        self._check_lost_shards()
         return results
+
+    def _note_shard_payload(self, payload) -> Optional[str]:
+        """Validate/adopt a probed replica's shard spec.  The newest
+        spec VERSION wins fleet-wide (a rebalance rolls out by bumping
+        it); a replica whose spec_id disagrees with the adopted
+        partition gets an error string back — the probe counts it as a
+        failed probe, so it leaves rotation instead of merging margins
+        from a different partition."""
+        info = (payload or {}).get("shard")
+        if info is None:
+            return None
+        try:
+            spec = ShardSpec.from_dict(info)
+        except (ValueError, KeyError, TypeError) as e:
+            return f"unusable shard spec in /healthz: {e}"
+        with self._lock:
+            cur = self._shard_spec
+            if cur is None or spec.version > cur.version:
+                self._shard_spec = cur = spec
+        if spec.spec_id() != cur.spec_id():
+            return (f"shard spec {spec.spec_id()!r} (v{spec.version}) "
+                    f"does not match the fleet partition "
+                    f"{cur.spec_id()!r} (v{cur.version})")
+        return None
+
+    def shard_coverage(self) -> Optional[Dict[int, int]]:
+        """Healthy replicas per shard index (None: fleet unsharded).
+        A zero anywhere means that slice of the entity space is DARK —
+        scoring degrades per FrontConfig.degraded_policy."""
+        with self._lock:
+            spec = self._shard_spec
+            if spec is None:
+                return None
+            cov = {k: 0 for k in range(spec.num_shards)}
+            for h in self._handles:
+                if h.ready and not h.detached and h.shard is not None \
+                        and h.shard in cov:
+                    cov[h.shard] += 1
+        return cov
+
+    def _check_lost_shards(self) -> None:
+        """Fire the shard.lost flight trigger on the transition of a
+        shard's LAST healthy replica leaving rotation (only for shards
+        that had coverage before — startup catch-up is not a loss)."""
+        cov = self.shard_coverage()
+        if cov is None:
+            return
+        with self._lock:
+            for k, n in cov.items():
+                if n > 0:
+                    self._seen_shards.add(k)
+            lost = {k for k, n in cov.items() if n == 0} & self._seen_shards
+            fresh = lost - self._lost_shards
+            recovered = self._lost_shards - lost
+            self._lost_shards = lost
+        for k in sorted(recovered):
+            telemetry.event("front_shard_recovered", shard=str(k))
+            logger.warning("front: shard %d has healthy replicas again",
+                           k)
+        for k in sorted(fresh):
+            logger.error(
+                "front: shard %d LOST its last healthy replica — "
+                "requests touching its entities now %s", k,
+                "degrade to partial scores"
+                if self.config.degraded_policy == "partial"
+                else "fail 503")
+            self._flight_fleet_dump("shard.lost", shard=str(k))
 
     def _flight_fleet_dump(self, reason: str, **attrs) -> None:
         """Dump the front's flight ring and broadcast the trigger to
@@ -269,6 +401,11 @@ class Front:
         self._m_ready.set(len(ready))
         if seqs:
             self._m_max_lag.set(max(seqs) - min(seqs))
+        cov = self.shard_coverage()
+        if cov is not None:
+            # the MIN healthy-replica count across shards: 0 here is the
+            # alertable "part of the entity space is dark" signal
+            self._m_shard_coverage.set(float(min(cov.values())))
 
     def start_probes(self) -> None:
         with self._lock:
@@ -309,13 +446,19 @@ class Front:
 
     # -- routing -------------------------------------------------------------
 
-    def _pick(self, exclude=()) -> Optional[ReplicaHandle]:
+    def _pick(self, exclude=(), shard: Optional[int] = None
+              ) -> Optional[ReplicaHandle]:
+        """Round-robin over ready replicas; `shard=k` restricts the pick
+        to replicas that declared ownership of shard k (which also keeps
+        the unsharded publisher out of a sharded fleet's scoring
+        rotation — it holds the full model but is not a leg)."""
         with self._lock:
             n = len(self._handles)
             for i in range(n):
                 h = self._handles[(self._rr + i) % n]
                 if h.ready and not h.draining and not h.detached \
-                        and h.url not in exclude:
+                        and h.url not in exclude \
+                        and (shard is None or h.shard == shard):
                     self._rr = (self._rr + i + 1) % n
                     h.inflight += 1
                     return h
@@ -338,6 +481,16 @@ class Front:
         """Route one scoring request (POST /score | /predict): bounded
         in-flight, failover across ready replicas, hedging on a slow
         attempt.  Returns (HTTP status, decoded payload)."""
+        leaf = path.rstrip("/").rsplit("/", 1)[-1]
+        if leaf in ("feedback", "swap", "rollback"):
+            # model-state changes are NOT idempotent: a hedge or a blind
+            # retry after an ambiguous timeout could apply the same
+            # feedback batch or swap twice — those routes go through
+            # route_publisher(), single attempt, no duplicates ever
+            raise ValueError(
+                f"{path!r} is a non-idempotent publisher route; the "
+                "front never hedges or retries it — use "
+                "route_publisher()")
         cfg = self.config
         with self._lock:
             if self._inflight_total >= cfg.max_inflight:
@@ -367,6 +520,11 @@ class Front:
                     path=path) as scope:
                 trace_headers = distributed.outbound_headers(
                     scope.request_id, distributed.current_ref())
+                with self._lock:
+                    sharded = self._shard_spec is not None
+                if sharded:
+                    return self._route_sharded(path, payload, body,
+                                               timeout, trace_headers)
                 return self._route_attempts(path, body, timeout,
                                             trace_headers)
         finally:
@@ -374,8 +532,8 @@ class Front:
                 self._inflight_total -= 1
 
     def _route_attempts(self, path: str, body: bytes, timeout: float,
-                        trace_headers: Optional[Dict[str, str]] = None
-                        ) -> Tuple[int, dict]:
+                        trace_headers: Optional[Dict[str, str]] = None,
+                        shard: Optional[int] = None) -> Tuple[int, dict]:
         cfg = self.config
         tried: set = set()
         pending: Dict[object, ReplicaHandle] = {}
@@ -385,7 +543,7 @@ class Front:
 
         def launch(hedge: bool = False) -> bool:
             nonlocal sends
-            h = self._pick(exclude=tried)
+            h = self._pick(exclude=tried, shard=shard)
             if h is None:
                 return False
             tried.add(h.url)
@@ -471,6 +629,177 @@ class Front:
                 outcome(h, "abandoned")
                 fut.add_done_callback(
                     lambda _f, _h=h: self._release(_h))
+
+    # -- sharded fan-out -------------------------------------------------------
+
+    def _route_leg(self, shard: int, body: bytes, timeout: float,
+                   trace_headers: Optional[Dict[str, str]]
+                   ) -> Tuple[int, dict]:
+        """One shard group's leg of a fan-out request: POST /margins to
+        that shard's replicas with the full hedged/failover discipline.
+        Transient injected faults at shard.route retry here (bounded);
+        a fatal one fails only this leg — the merge then applies the
+        degradation policy, so the blast radius stays one shard."""
+        last: Optional[Exception] = None
+        for _ in range(self.config.max_attempts):
+            try:
+                faults.fire("shard.route", shard=str(shard))
+            except Exception as e:
+                if not faults.is_transient(e):
+                    raise
+                last = e
+                self._m_retries.inc()
+                continue
+            return self._route_attempts("/margins", body, timeout,
+                                        trace_headers, shard=shard)
+        raise last  # every attempt was consumed by injected transients
+
+    def _collect_legs(self, shard_list, body, timeout, trace_headers,
+                      legs_raw: Dict[int, dict],
+                      failed: Dict[int, str]) -> None:
+        """Fan one round of legs out on the leg pool and sort the
+        responses into `legs_raw` / `failed` (per-shard outcome
+        counters included)."""
+        futs = {k: self._leg_pool.submit(self._route_leg, k, body,
+                                         timeout, trace_headers)
+                for k in shard_list}
+        for k, fut in futs.items():
+            try:
+                status, decoded = fut.result()
+            except Exception as e:
+                failed[k] = f"{type(e).__name__}: {e}"
+                self._m_shard_requests.inc(shard=str(k), outcome="failed")
+                continue
+            if status != 200:
+                failed[k] = (f"http {status}: "
+                             f"{(decoded or {}).get('error', '')}")
+                self._m_shard_requests.inc(shard=str(k), outcome="failed")
+                continue
+            legs_raw[k] = decoded
+            self._m_shard_requests.inc(shard=str(k), outcome="ok")
+
+    def _route_sharded(self, path: str, payload: dict, body: bytes,
+                       timeout: float,
+                       trace_headers: Optional[Dict[str, str]]
+                       ) -> Tuple[int, dict]:
+        """Route one scoring request across an entity-sharded fleet:
+        fan /margins legs to every shard the request's entity ids touch
+        (plus one primary leg for the replicated FE/MF coordinates),
+        merge the per-coordinate margins bit-identically to a monolithic
+        replica, and degrade per `degraded_policy` when a touched shard
+        has no healthy replica."""
+        with self._lock:
+            spec = self._shard_spec
+            meta = self._coord_meta
+        ids = payload.get("ids") or {}
+        cov = self.shard_coverage() or {}
+        covered = sorted(k for k, c in cov.items() if c > 0)
+        if not covered:
+            self._m_errors.inc()
+            raise NoReadyReplica(
+                "no shard has a healthy replica — the sharded fleet "
+                "cannot place any leg")
+        if meta is not None:
+            needed = set(shards_touched(spec, meta, ids))
+        else:
+            # the coordinate fold order is unknown until a first leg
+            # answers: fan to every shard rather than guess
+            needed = set(range(spec.num_shards))
+        # the replicated FE/MF margins come from the lowest covered leg
+        needed.add(covered[0])
+        legs_raw: Dict[int, dict] = {}
+        failed: Dict[int, str] = {}
+        self._collect_legs(sorted(k for k in needed if cov.get(k, 0) > 0),
+                           body, timeout, trace_headers, legs_raw, failed)
+        if not legs_raw:
+            self._m_errors.inc()
+            raise NoReadyReplica(
+                f"every shard leg failed: { {k: failed[k] for k in sorted(failed)} }")
+        versions = {str(leg.get("model_version"))
+                    for leg in legs_raw.values()}
+        if len(versions) > 1:
+            # legs scored different model versions: merging them would
+            # mix tables — this window closes as the swap replicates
+            self._m_errors.inc()
+            return 503, {"error": "shard legs disagree on model version "
+                                  "(fleet mid-swap); retry",
+                         "versions": sorted(versions)}
+        meta = legs_raw[min(legs_raw)]["coordinates"]
+        with self._lock:
+            self._coord_meta = meta
+        # a swap can change the coordinate set under a stale cached fold
+        # order: fan one catch-up round to any newly-needed shards
+        extra = sorted(k for k in shards_touched(spec, meta, ids)
+                       if k not in needed and cov.get(k, 0) > 0)
+        if extra:
+            self._collect_legs(extra, body, timeout, trace_headers,
+                               legs_raw, failed)
+        legs = {k: {name: decode_array(enc)
+                    for name, enc in leg["margins"].items()}
+                for k, leg in legs_raw.items()}
+        fold = ",".join(m["name"] for m in meta)
+        merged = last = None
+        for _ in range(self.config.max_attempts):
+            try:
+                faults.fire("shard.merge", coordinate=fold)
+                merged = merge_margins(spec, meta, ids, legs, min(legs),
+                                       missing_policy="partial")
+                break
+            except ShardMergeError as e:
+                self._m_errors.inc()
+                return 503, {"error": f"shard merge failed: {e}"}
+            except Exception as e:
+                if not faults.is_transient(e):
+                    raise
+                # a pure host fold over already-collected legs: the
+                # retry is bit-exact by construction
+                last = e
+                self._m_retries.inc()
+        if merged is None:
+            raise last
+        scores = merged["scores"]
+        a_leg = legs_raw[min(legs_raw)]
+        out: Dict[str, object] = {
+            "model_version": a_leg.get("model_version"),
+            "sharded": True,
+            "shards": sorted(legs_raw),
+        }
+        if merged["missing_shards"]:
+            self._m_shard_degraded.inc()
+            if self.config.degraded_policy == "error":
+                self._m_errors.inc()
+                return 503, {
+                    "error": "shard(s) "
+                             f"{merged['missing_shards']} have no healthy "
+                             "replica and the degradation policy is "
+                             "'error'",
+                    "missing_shards": merged["missing_shards"],
+                    "partial_rows": merged["partial_rows"]}
+            # partial: the lost shards' random-effect contributions fold
+            # as exactly 0.0 (the unseen-entity default), stamped so the
+            # caller KNOWS these rows are partial
+            out["degraded"] = True
+            out["missing_shards"] = merged["missing_shards"]
+            out["partial_rows"] = merged["partial_rows"]
+        if path.rstrip("/").rsplit("/", 1)[-1] == "predict":
+            # host-side inverse link, identical to the replica's
+            # mean_prediction: f64 margins (+ offsets), one eager device
+            # mean — no jit, no fresh traces
+            from photon_ml_tpu.ops import TASK_LOSSES
+            import jax.numpy as jnp
+            loss = TASK_LOSSES.get(str(a_leg.get("task_type")))
+            if loss is None or getattr(loss, "mean", None) is None:
+                self._m_errors.inc()
+                return 503, {"error": f"task {a_leg.get('task_type')!r} "
+                                      "has no mean function"}
+            z = np.asarray(scores, np.float64)
+            if payload.get("offsets") is not None:
+                z = z + np.asarray(payload["offsets"], np.float64)
+            out["predictions"] = np.asarray(loss.mean(
+                jnp.asarray(z))).tolist()
+        else:
+            out["scores"] = np.asarray(scores, np.float64).tolist()
+        return 200, out
 
     def publisher_handle(self) -> Optional[ReplicaHandle]:
         with self._lock:
@@ -597,8 +926,20 @@ class Front:
             replicas = [h.state() for h in self._handles]
             ready = sum(1 for h in self._handles
                         if h.ready and not h.detached)
-        return {"role": "front", "ready_replicas": ready,
-                "replicas": replicas}
+            spec = self._shard_spec
+        out: Dict[str, object] = {"role": "front",
+                                  "ready_replicas": ready,
+                                  "replicas": replicas}
+        cov = self.shard_coverage()
+        if cov is not None:
+            out["shards"] = {
+                "spec": spec.to_dict(),
+                "policy": self.config.degraded_policy,
+                "coverage": {str(k): v for k, v in sorted(cov.items())},
+                "shards_down": sorted(k for k, v in cov.items()
+                                      if v == 0),
+            }
+        return out
 
     def prometheus_metrics(self) -> str:
         self._refresh_gauges()
@@ -630,6 +971,9 @@ class Front:
             "ready_replicas": g["fleet.front_ready_replicas"],
             "max_lag_seq": g["fleet.front_max_lag_seq"],
             "requests_by_replica": snap["labeled"]["front.requests"],
+            "shard_requests": snap["labeled"]["fleet.shard_requests"],
+            "shard_coverage": g["fleet.shard_coverage"],
+            "shard_degraded": c["fleet.shard_degraded"],
         }
 
     def _fleet_lag(self) -> Dict[str, object]:
@@ -750,3 +1094,4 @@ class Front:
         if thread is not None:
             thread.join(timeout=5.0)
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._leg_pool.shutdown(wait=False, cancel_futures=True)

@@ -56,7 +56,8 @@ from photon_ml_tpu.telemetry.timings import clock
 
 from photon_ml_tpu.fleet.replog import (ReplicationLog, ReplicationLogError,
                                         _FoldState, decode_array,
-                                        delta_from_record, record_for_event)
+                                        delta_from_record, record_for_event,
+                                        record_for_shard_map)
 from photon_ml_tpu.utils import durable, faults, locktrace
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -90,9 +91,17 @@ class FleetPublisher:
 
     def __init__(self, service, log: ReplicationLog,
                  model_dir: Optional[str] = None, max_attempts: int = 3,
-                 backoff_s: float = 0.02):
+                 backoff_s: float = 0.02, shard_spec=None):
+        """`shard_spec` (a fleet.shards.ShardSpec) declares the fleet's
+        entity partition: anchoring an empty log appends a `shard_map`
+        record BEFORE the base swap, so every joining replica learns (and
+        validates against) the partition it must filter by.  The
+        publisher itself stays UNSHARDED — it holds the full model,
+        solves online deltas against it, and the per-replica shard
+        filtering happens at apply time on the followers."""
         self.service = service
         self.log = log
+        self.shard_spec = shard_spec
         self.max_attempts = int(max_attempts)
         self.backoff_s = float(backoff_s)
         self._lock = locktrace.tracked(threading.Lock(),
@@ -107,22 +116,45 @@ class FleetPublisher:
             logger.warning("replication log: truncated %d torn tail "
                            "byte(s) left by a previous crash", dropped)
         self._next = service.registry.add_publish_hook(self._on_event)
-        # anchor an empty log with the CURRENT model as its first swap
+        # anchor an empty log: the shard map (when the fleet is
+        # entity-sharded) and then the CURRENT model as its first swap
         # record, so replicas that joined with a different --model-dir
         # still converge onto the publisher's base model
-        if log.head_seq() == 0 and model_dir is not None:
-            self._append_with_retry({
-                "kind": "swap",
-                "version": service.registry.version,
-                "previous_version": None,
-                "source_dir": str(model_dir)})
+        if log.head_seq() == 0:
+            if shard_spec is not None:
+                self._append_with_retry(record_for_shard_map(shard_spec))
+            if model_dir is not None:
+                self._append_with_retry({
+                    "kind": "swap",
+                    "version": service.registry.version,
+                    "previous_version": None,
+                    "source_dir": str(model_dir)})
 
     def status(self) -> Dict[str, object]:
         with self._lock:
-            return {"role": "publisher", "failed": self._failed,
-                    "appended": self._appended,
-                    "pending_events": len(self._buffer),
-                    "head_seq": None}
+            out = {"role": "publisher", "failed": self._failed,
+                   "appended": self._appended,
+                   "pending_events": len(self._buffer),
+                   "head_seq": None}
+        if self.shard_spec is not None:
+            out["shard_spec"] = self.shard_spec.to_dict()
+        return out
+
+    def shard_audit(self, shard_index: int) -> Dict[str, object]:
+        """The publisher-side half of a per-shard audit: sha256 of its
+        FULL tables' rows filtered to `shard_index`'s owned entities
+        (GET /fleet/audit?shard=K).  A converged shard replica's
+        `table_hashes()` reports the identical hashes, since its
+        resident tables ARE that filtered slice."""
+        if self.shard_spec is None:
+            raise ValueError("this publisher has no shard spec "
+                             "(cli.serve --shard-count)")
+        scorer = self.service.registry.scorer
+        return {"version_vector": self.service.version_vector(),
+                "shard": {"index": int(shard_index),
+                          **self.shard_spec.to_dict()},
+                "table_hashes": scorer.shard_table_hashes(
+                    self.shard_spec, int(shard_index))}
 
     # -- the ordered event -> record pump ------------------------------------
 
@@ -446,6 +478,32 @@ class Replica:
         kind = rec["kind"]
         faults.fire("replica.apply", kind=kind)
         registry = self.service.registry
+        shard = getattr(registry.scorer, "shard", None)
+        if shard is not None:
+            # sharded catch-up fault site: fired INSIDE the apply retry
+            # loop, so injected transients exercise the same backoff
+            # discipline as any replicated apply; fatals mark the
+            # replica failed exactly like replica.apply
+            faults.fire("shard.catchup", shard=str(shard.index))
+        if kind == "shard_map":
+            if shard is None:
+                return "skipped"  # full-model replica: owns everything
+            from photon_ml_tpu.fleet.shards import ShardSpec
+            try:
+                spec = ShardSpec.from_dict(rec["spec"])
+            except ValueError as e:
+                raise ReplicaError(
+                    f"shard_map record at seq {env['log_seq']} is "
+                    f"unusable ({e})") from e
+            if spec != shard.spec:
+                raise ReplicaError(
+                    f"shard_map record at seq {env['log_seq']} announces "
+                    f"partition {spec.to_dict()} but this replica was "
+                    f"built for {shard.spec.to_dict()} — a replica "
+                    "cannot re-partition live; restart it with the "
+                    "fleet's spec (cli.serve --shard K/N matching the "
+                    "publisher's --shard-count)")
+            return "applied"
         if kind == "swap":
             if registry.version == rec["version"]:
                 return "skipped"  # same version: the join-time base model
@@ -502,12 +560,16 @@ class Replica:
 
     def status(self) -> Dict[str, object]:
         with self._lock:
-            return {"role": "replica", "ready": self._ready,
-                    "draining": self._draining, "failed": self._failed,
-                    "applied_seq": self._applied_seq,
-                    "lag_seq": max(self._head_seen - self._applied_seq, 0),
-                    "catchup_s": (None if self._catchup_s is None
-                                  else round(self._catchup_s, 3))}
+            out = {"role": "replica", "ready": self._ready,
+                   "draining": self._draining, "failed": self._failed,
+                   "applied_seq": self._applied_seq,
+                   "lag_seq": max(self._head_seen - self._applied_seq, 0),
+                   "catchup_s": (None if self._catchup_s is None
+                                 else round(self._catchup_s, 3))}
+        shard = self.service.registry.scorer.shard_info()
+        if shard is not None:
+            out["shard"] = shard
+        return out
 
     def audit(self) -> Dict[str, object]:
         """Version vector + table hashes + applied seq: the convergence

@@ -415,6 +415,9 @@ class _FoldState:
         self.delta_seq = 0
         self.rows: Dict[str, Dict[int, np.ndarray]] = {}
         self.previous = None  # (model_dir, version, delta_seq, rows)
+        # entity-shard partition (fleet/shards.py ShardSpec.to_dict):
+        # fleet topology, not model state — survives swaps/rollbacks
+        self.shard_map: Optional[dict] = None
 
     @classmethod
     def from_snapshot(cls, snap: Optional[dict]) -> "_FoldState":
@@ -429,6 +432,7 @@ class _FoldState:
             rows = decode_array(enc["rows"])
             values = decode_array(enc["values"])
             st.rows[lane] = {int(r): v for r, v in zip(rows, values)}
+        st.shard_map = snap.get("shard_map")
         return st
 
     def fold(self, env: dict) -> None:
@@ -461,6 +465,11 @@ class _FoldState:
                                 decode_array(enc["values"])):
                     lane_rows[int(r)] = v
             self.delta_seq = int(rec["to_delta_seq"])
+        elif kind == "shard_map":
+            # versioned entity partition announcement: last one wins (a
+            # rebalance appends a new record; replicas built for another
+            # spec refuse it at apply time, not here)
+            self.shard_map = dict(rec["spec"])
         elif kind == "rollback":
             if self.previous is None or self.previous[0] is None:
                 raise ReplicationLogError(
@@ -490,10 +499,13 @@ class _FoldState:
                 "rows": encode_array(np.asarray(idx, np.int64)),
                 "values": encode_array(np.stack(
                     [lane_rows[r] for r in idx]))}
-        return {"format_version": 1, "upto_seq": self.seq,
-                "model_dir": self.model_dir, "version": self.version,
-                "delta_seq": self.delta_seq, "restored": restored,
-                "created_at": time.time()}
+        out = {"format_version": 1, "upto_seq": self.seq,
+               "model_dir": self.model_dir, "version": self.version,
+               "delta_seq": self.delta_seq, "restored": restored,
+               "created_at": time.time()}
+        if self.shard_map is not None:
+            out["shard_map"] = dict(self.shard_map)
+        return out
 
 
 # -- record constructors (the publisher's event -> record mapping) -----------
@@ -535,6 +547,15 @@ def record_for_event(event: dict) -> dict:
                 "previous_version": event.get("previous_version"),
                 "degraded": bool(event.get("degraded", False))}
     raise ReplicationLogError(f"unknown publish event kind {kind!r}")
+
+
+def record_for_shard_map(spec) -> dict:
+    """A fleet shard-map announcement (fleet/shards.py ShardSpec) -> its
+    log record.  The publisher appends one when it anchors a sharded
+    fleet's log (and after any rebalance bumps the spec version), so the
+    partition every replica filters by is itself replicated, versioned,
+    and audited like model state."""
+    return {"kind": "shard_map", "spec": spec.to_dict()}
 
 
 def delta_from_record(rec: dict):

@@ -121,6 +121,10 @@ SNAPSHOT_PATHS = {
     "fleet.feedback_visible_s": ("fleet", "feedback_visible_ms"),
     "fleet.log_records": ("fleet", "log_records"),
     "fleet.log_bytes": ("fleet", "log_bytes"),
+    "fleet.shard_index": ("fleet", "shard_index"),
+    "fleet.shard_count": ("fleet", "shard_count"),
+    "fleet.shard_owned_rows": ("fleet", "shard_owned_rows"),
+    "fleet.shard_rows_dropped": ("fleet", "shard_rows_dropped"),
     "refit.runs": ("refit", "runs"),
     "refit.swaps": ("refit", "swaps"),
     "refit.failures": ("refit", "failures"),
@@ -261,6 +265,18 @@ class ServingMetrics:
         # pressure replog compaction relieves
         self._fleet_log_records = r.gauge("fleet.log_records")
         self._fleet_log_bytes = r.gauge("fleet.log_bytes")
+        # entity-sharded serving (fleet/shards.py): which slice of the
+        # random-effect entity space this replica owns.  shard_index is
+        # -1 / shard_count 0 when unsharded; owned_rows is the summed
+        # logical RE rows resident; rows_dropped counts replicated rows
+        # the shard filter discarded as unowned (synced from the live
+        # scorer's cumulative total at render, set_store_probe-style)
+        self._shard_index = r.gauge("fleet.shard_index")
+        self._shard_index.set(-1.0)
+        self._shard_count = r.gauge("fleet.shard_count")
+        self._shard_owned_rows = r.gauge("fleet.shard_owned_rows")
+        self._shard_rows_dropped = r.counter("fleet.shard_rows_dropped")
+        self._shard_probe = None
         # -- continuous-training tier (photon_ml_tpu/refit/) -----------------
         # all zeros until a refit driver binds; last_success_age_s is -1
         # until the first successful cycle (alert on it growing past the
@@ -467,6 +483,32 @@ class ServingMetrics:
         with self._lock:
             self._store_probe = fn
 
+    def set_shard_probe(self, fn) -> None:
+        """`fn() -> CompiledScorer.shard_info()` (None when unsharded) —
+        the live scorer's shard identity + filter totals, refreshed on
+        BOTH render paths."""
+        with self._lock:
+            self._shard_probe = fn
+
+    def _refresh_shard_gauges(self) -> None:
+        with self._lock:
+            probe = self._shard_probe
+        if probe is None:
+            return
+        try:
+            info = probe()
+        except Exception:
+            return  # a swapping scorer must not take the scrape down
+        if info is None:
+            return
+        self._shard_index.set(int(info.get("index", -1)))
+        self._shard_count.set(int(info.get("num_shards", 0)))
+        self._shard_owned_rows.set(
+            int(sum(info.get("owned_rows", {}).values())))
+        gap = int(info.get("rows_dropped", 0)) - self._shard_rows_dropped.value
+        if gap > 0:  # monotonic: a swap resets the scorer's total
+            self._shard_rows_dropped.inc(gap)
+
     def _refresh_store_counters(self) -> None:
         """Sync the store.* counters to the probe's cumulative totals
         (monotonic: a model swap resets the scorer's totals, never the
@@ -575,6 +617,7 @@ class ServingMetrics:
     def snapshot(self, model_version: Optional[str] = None) -> Dict:
         self._refresh_online_gauges()
         self._refresh_store_counters()
+        self._refresh_shard_gauges()
         with self._lock:
             batches = self._batches.value
             bucket_rows = self._bucket_rows.value
@@ -741,6 +784,10 @@ class ServingMetrics:
                 self._fleet_feedback_visible.snapshot()),
             "log_records": self._fleet_log_records.value,
             "log_bytes": self._fleet_log_bytes.value,
+            "shard_index": self._shard_index.value,
+            "shard_count": self._shard_count.value,
+            "shard_owned_rows": self._shard_owned_rows.value,
+            "shard_rows_dropped": self._shard_rows_dropped.value,
         }
 
     def _refit_snapshot(self) -> Dict:
@@ -761,6 +808,7 @@ class ServingMetrics:
         self._refresh_model_age()
         self._refresh_online_gauges()
         self._refresh_store_counters()
+        self._refresh_shard_gauges()
         self._refresh_refit_age()
         info = {"model_version": model_version} if model_version else None
         return prometheus_text(self.registry, extra_info=info)

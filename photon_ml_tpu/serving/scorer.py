@@ -120,11 +120,21 @@ class CompiledScorer:
 
     def __init__(self, model: GameModel, *, max_batch: int = 1024,
                  min_bucket: int = 8, version: Optional[str] = None,
-                 store=None, store_dir: Optional[str] = None):
+                 store=None, store_dir: Optional[str] = None,
+                 shard=None, warm_margins: Optional[bool] = None):
         if max_batch < 1 or min_bucket < 1:
             raise ValueError("max_batch and min_bucket must be >= 1")
         self.model = model
         self.version = version
+        # entity-sharded serving (fleet/shards.py): a ShardAssignment
+        # makes this scorer hold ONLY its owned slice of every
+        # random-effect table (FE/MF coordinates replicate in full), and
+        # filter replicated delta/row-state scatters to owned rows
+        self.shard = shard
+        # margins-program warmup: sharded replicas serve score_margins()
+        # on the fan-out path, so they pre-compile it by default
+        self.warm_margins = (shard is not None if warm_margins is None
+                             else bool(warm_margins))
         self.max_batch = int(ceil_pow2(max_batch))
         self.min_bucket = min(int(ceil_pow2(min_bucket)), self.max_batch)
         self._loss = L.TASK_LOSSES.get(model.task_type)
@@ -150,8 +160,36 @@ class CompiledScorer:
         self._lookups: Dict[str, dict] = {}                # lane key -> id map
         self._table_slot: Dict[str, int] = {}              # RE name -> slot
         self._overlay_slot: Dict[str, int] = {}            # store coord -> slot
+        self._entity_ids: Dict[str, np.ndarray] = {}       # RE name -> ids held
+        self._shard_row_maps: Dict[str, dict] = {}         # RE name -> full->local
+        self._logical_rows: Dict[str, int] = {}            # RE name -> owned rows
+        self.shard_rows_dropped = 0   # unowned delta/replay rows filtered
         tables = []
         shard_dims: Dict[str, int] = {}
+
+        def shard_slice(m):
+            """A RE coordinate's (entity_ids, table, full->local map) under
+            this scorer's shard assignment — owned rows only, ORIGINAL row
+            order preserved (so the slice is a pure filter of the full
+            table and per-shard audits hash the same bytes on the
+            publisher's filtered view and the replica's resident table).
+            A shard owning zero entities keeps one never-addressed zero
+            row so the gather programs stay well-formed; its logical row
+            count is 0 and audits hash the empty slice."""
+            ids_full = np.asarray(m.entity_ids)
+            table_full = np.asarray(m.global_coefficients())
+            if self.shard is None:
+                return ids_full, table_full, None, len(ids_full)
+            mask = self.shard.spec.owned_mask(ids_full, self.shard.index)
+            row_map = {int(full): local for local, full
+                       in enumerate(np.nonzero(mask)[0].tolist())}
+            ids_own = ids_full[mask]
+            table_own = table_full[mask]
+            logical = len(ids_own)
+            if logical == 0:
+                table_own = np.zeros((1, table_full.shape[1]),
+                                     table_full.dtype)
+            return ids_own, table_own, row_map, logical
 
         def note_shard(shard, dim, owner):
             prev = shard_dims.setdefault(shard, int(dim))
@@ -170,15 +208,22 @@ class CompiledScorer:
                 # stacked per-entity table in the ORIGINAL shard space:
                 # projected/factored coordinates materialize P^T c once at
                 # load so serving is a single gather + row dot per request
+                own_ids, own_table, row_map, logical = shard_slice(m)
+                self._entity_ids[name] = own_ids
+                self._logical_rows[name] = logical
+                if row_map is not None:
+                    self._shard_row_maps[name] = row_map
                 if store is not None:
                     import os
                     from photon_ml_tpu.store import TieredEntityStore
-                    table_np = np.asarray(m.global_coefficients())
+                    table_np = own_table
                     note_shard(m.feature_shard, table_np.shape[-1], name)
                     st = TieredEntityStore.create(
                         os.path.join(store_dir, name.replace("/", "_")),
                         table_np, store,
-                        entity_ids=np.asarray(m.entity_ids), name=name)
+                        entity_ids=own_ids if logical else
+                        np.asarray(["\0__shard_pad__"], dtype=object),
+                        name=name)
                     self._stores[name] = st
                     self._re_meta.append((name, m.feature_shard,
                                           m.random_effect_type))
@@ -194,11 +239,12 @@ class CompiledScorer:
                     tables.append(jnp.zeros((st.overlay_rows, st.dim),
                                             st.dtype))
                 else:
-                    table = jnp.asarray(m.global_coefficients())
+                    table = jnp.asarray(own_table)
                     note_shard(m.feature_shard, table.shape[-1], name)
                     self._re_meta.append((name, m.feature_shard,
                                           m.random_effect_type))
-                    self._lookups[name] = _id_lookup(m.entity_ids)
+                    self._lookups[name] = (_id_lookup(own_ids) if logical
+                                           else {})
                     self._table_slot[name] = len(tables)
                     tables.append(table)
             elif isinstance(m, MatrixFactorizationModel):
@@ -226,6 +272,9 @@ class CompiledScorer:
         # ARGUMENTS (not closed-over constants), so a same-shape hot swap
         # reuses every compiled bucket program
         self._program = jax.jit(self._compute)
+        # the fan-out twin: same contributions, returned per coordinate
+        # instead of folded — what sharded replicas serve to the front
+        self._program_margins = jax.jit(self._compute_margins)
         self._seen_buckets: set = set()
         self.bucket_compiles = 0
         self.warmup_s = 0.0
@@ -242,11 +291,14 @@ class CompiledScorer:
     def from_model_dir(cls, model_dir: str, *, max_batch: int = 1024,
                        min_bucket: int = 8, version: Optional[str] = None,
                        warmup: bool = True, store=None,
-                       store_dir: Optional[str] = None) -> "CompiledScorer":
+                       store_dir: Optional[str] = None, shard=None,
+                       warm_margins: Optional[bool] = None
+                       ) -> "CompiledScorer":
         from photon_ml_tpu.models.io import load_game_model
         model, _config = load_game_model(model_dir)
         scorer = cls(model, max_batch=max_batch, min_bucket=min_bucket,
-                     version=version, store=store, store_dir=store_dir)
+                     version=version, store=store, store_dir=store_dir,
+                     shard=shard, warm_margins=warm_margins)
         if warmup:
             scorer.warmup()
         return scorer
@@ -283,6 +335,9 @@ class CompiledScorer:
                 lanes = {k: np.full(b, -1, np.int32)
                          for k in self._lane_names()}
                 jax.block_until_ready(self._run_bucket(xs, lanes, b))
+                if self.warm_margins:
+                    jax.block_until_ready(
+                        self._run_bucket(xs, lanes, b, margins=True))
         self.warmup_s = clock() - t0
         self.warmed = True
         return self.warmup_s
@@ -322,7 +377,58 @@ class CompiledScorer:
             add(jnp.where(ok, jnp.sum(rfa * cfa, axis=-1), 0.0))
         return total
 
-    def _run_bucket(self, xs, lanes, bucket: int, store_tables=None):
+    def coordinate_meta(self) -> List[Dict[str, str]]:
+        """The coordinate fold order as data — one ordered entry per
+        margin `_compute` adds (FE, then RE, then MF, each in model
+        order).  This is the merge contract of entity-sharded fan-out
+        scoring: the front re-folds per-coordinate margins host-side in
+        EXACTLY this order (fleet/shards.py merge_margins), which is what
+        makes merged scores bit-identical to a monolithic replica's."""
+        out: List[Dict[str, str]] = []
+        for name, shard in self._fe_meta:
+            out.append({"name": name, "kind": "fixed",
+                        "feature_shard": shard})
+        for name, shard, re_type in self._re_meta:
+            out.append({"name": name, "kind": "random",
+                        "feature_shard": shard, "entity_type": re_type})
+        for name, row_t, col_t in self._mf_meta:
+            out.append({"name": name, "kind": "matrix",
+                        "row_type": row_t, "col_type": col_t})
+        return out
+
+    def _compute_margins(self, tables, xs, lanes):
+        """Per-coordinate margins for one padded bucket, in
+        `coordinate_meta()` order — the same contribution terms `_compute`
+        folds, returned unfolded.  A tiered coordinate's hot-table and
+        staging-window contributions combine into ONE margin here (a row
+        lives in exactly one of the two, the other lane is -1 -> 0.0), so
+        the margin is the coordinate's full contribution regardless of
+        tiering — and the merge fold stays one add per coordinate,
+        matching the fully-resident monolithic chain."""
+        i = 0
+        margins = []
+        for _name, shard in self._fe_meta:
+            w = tables[i]; i += 1
+            margins.append(xs[shard] @ w)
+        for name, shard, _re_type in self._re_meta:
+            table = tables[i]; i += 1
+            z = score_by_entity(table, xs[shard], lanes[name])
+            if name in self._stores:
+                overlay = tables[i]; i += 1
+                z = z + score_by_entity(overlay, xs[shard],
+                                        lanes[name + "@stage"])
+            margins.append(z)
+        for name, _row_t, _col_t in self._mf_meta:
+            rf, cf = tables[i], tables[i + 1]; i += 2
+            rl, cl = lanes[name + "/row"], lanes[name + "/col"]
+            ok = (rl >= 0) & (cl >= 0)
+            rfa = rf[jnp.maximum(rl, 0)]
+            cfa = cf[jnp.maximum(cl, 0)]
+            margins.append(jnp.where(ok, jnp.sum(rfa * cfa, axis=-1), 0.0))
+        return tuple(margins)
+
+    def _run_bucket(self, xs, lanes, bucket: int, store_tables=None,
+                    margins: bool = False):
         if bucket not in self._seen_buckets:
             self._seen_buckets.add(bucket)
             self.bucket_compiles += 1
@@ -349,6 +455,8 @@ class CompiledScorer:
                 t[self._table_slot[name]] = table
                 t[self._overlay_slot[name]] = windows[name]
             tables = tuple(t)
+        if margins:
+            return self._program_margins(tables, xs, lanes)
         return self._program(tables, xs, lanes)
 
     # -- online row-level updates ------------------------------------------
@@ -397,6 +505,23 @@ class CompiledScorer:
         return _gather_rows(self.re_table(name),
                             jnp.asarray(np.asarray(rows, np.int64)))
 
+    def _filter_shard_rows(self, name: str, rows: np.ndarray,
+                           values: np.ndarray
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+        """The shard-filtering chokepoint of EVERY row write: replicated
+        deltas, rollback row-state replays, and snapshot bootstraps all
+        carry FULL-model row indices; a sharded scorer keeps only its
+        owned rows, remapped to its local (filtered) table space.
+        Unowned rows drop silently — their owner's replica applies them —
+        and are counted in `shard_rows_dropped`."""
+        row_map = self._shard_row_maps.get(name)
+        if row_map is None:
+            return rows, values
+        keep = [i for i, r in enumerate(rows.tolist()) if int(r) in row_map]
+        self.shard_rows_dropped += len(rows) - len(keep)
+        local = np.asarray([row_map[int(rows[i])] for i in keep], np.int64)
+        return local, values[keep]
+
     def _scatter_coordinate(self, name: str, rows: np.ndarray,
                             values: np.ndarray,
                             promote: bool = False) -> None:
@@ -407,6 +532,9 @@ class CompiledScorer:
                            f"table (updatable: {known})")
         rows = np.asarray(rows, np.int64)
         values = np.asarray(values)
+        rows, values = self._filter_shard_rows(name, rows, values)
+        if len(rows) == 0 and self.shard is not None:
+            return  # this shard owns none of the delta's rows
         st = self._stores.get(name)
         if st is not None:
             # tiered mode: the delta lands in whatever tier each row
@@ -496,15 +624,18 @@ class CompiledScorer:
                 # tiered mode hashes the LOGICAL table (cold + warm
                 # overlay): two replicas whose tiering histories differ
                 # but whose row values agree hash identically
-                out[name] = hashlib.sha256(
-                    np.ascontiguousarray(st.full_table())
-                    .tobytes()).hexdigest()
-                i += 2          # main hot table + staging overlay
+                rows_np = np.asarray(st.full_table())
             else:
-                out[name] = hashlib.sha256(
-                    np.ascontiguousarray(np.asarray(self._tables[i]))
-                    .tobytes()).hexdigest()
-                i += 1
+                rows_np = np.asarray(self._tables[i])
+            if self.shard is not None:
+                # sharded mode hashes the OWNED slice (a zero-owned
+                # shard's never-addressed pad row is excluded), so the
+                # hash equals the publisher's shard_table_hashes() of
+                # the same filtered rows
+                rows_np = rows_np[:self._logical_rows[name]]
+            out[name] = hashlib.sha256(
+                np.ascontiguousarray(rows_np).tobytes()).hexdigest()
+            i += 2 if st is not None else 1
         for name, _row_t, _col_t in self._mf_meta:
             for side in ("/row", "/col"):
                 out[name + side] = hashlib.sha256(
@@ -673,6 +804,85 @@ class CompiledScorer:
             scores=out, num_rows=n, buckets=buckets,
             entity_lookups=lookups, entity_hits=hits,
             new_compiles=self.bucket_compiles - compiles0)
+
+    def score_margins(self, features: Dict[str, np.ndarray],
+                      ids: Optional[Dict[str, np.ndarray]] = None,
+                      ) -> Dict[str, np.ndarray]:
+        """Per-coordinate margins for a request batch (chunked at
+        max_batch like `score`), keyed by coordinate name in
+        `coordinate_meta()` order — one sharded replica's leg of a
+        fan-out request.  Margins keep the device program's COMPUTE
+        dtype (the merge fold must run in it to reproduce the on-device
+        add chain bit-for-bit; `score` casts to f64 only at the end).
+        Unowned/unseen entities resolve to lane -1 and contribute
+        exactly 0.0, so the merge can fold any leg's margin for a
+        coordinate the leg does not own without perturbing bits."""
+        ids = ids or {}
+        n = self.validate_request(features, ids)
+        meta = self.coordinate_meta()
+        out = {m["name"]: np.empty(n, np.dtype(self._dtype)) for m in meta}
+        for lo in range(0, n, self.max_batch):
+            hi = min(lo + self.max_batch, n)
+            m = hi - lo
+            bucket = min(max(int(ceil_pow2(m)), self.min_bucket),
+                         self.max_batch)
+            pad = bucket - m
+            xs = {}
+            for shard in self.feature_shards:
+                x = np.asarray(features[shard])[lo:hi]
+                xs[shard] = (x if pad == 0 else
+                             np.pad(x, ((0, pad), (0, 0))))
+            lanes, _h, _lk, store_tables = self._lanes_for_chunk(ids, lo, hi)
+            if pad:
+                lanes = {k: np.pad(v, (0, pad), constant_values=-1)
+                         for k, v in lanes.items()}
+            margins = self._run_bucket(xs, lanes, bucket,
+                                       store_tables=store_tables,
+                                       margins=True)
+            for cm, z in zip(meta, margins):
+                out[cm["name"]][lo:hi] = np.asarray(z)[:m]
+        return out
+
+    # -- entity-sharded serving (fleet/shards.py) --------------------------
+
+    def shard_info(self) -> Optional[Dict[str, object]]:
+        """This scorer's shard identity + owned-row counts (the /healthz
+        and probe surface the front groups replicas by); None when the
+        scorer holds the full model."""
+        if self.shard is None:
+            return None
+        return {**self.shard.to_dict(),
+                "owned_rows": {name: self._logical_rows[name]
+                               for name, _s, _t in self._re_meta},
+                "rows_dropped": self.shard_rows_dropped}
+
+    def shard_table_hashes(self, spec, shard_index: int) -> Dict[str, str]:
+        """The per-shard audit on a FULL (publisher) scorer: sha256 of
+        every lane's rows FILTERED to `shard_index`'s owned entities
+        (original row order) — exactly the bytes a converged shard
+        replica's `table_hashes()` reports, since its resident table IS
+        that filtered slice.  FE/MF lanes replicate in full and hash
+        unfiltered."""
+        import hashlib
+        if self.shard is not None:
+            raise ValueError("shard_table_hashes audits the FULL model; "
+                             "this scorer already holds only shard "
+                             f"{self.shard.index}")
+        full = self.table_hashes()
+        out: Dict[str, str] = {}
+        for name, _shard in self._fe_meta:
+            out[name] = full[name]
+        for name, _shard, _re_type in self._re_meta:
+            st = self._stores.get(name)
+            table = (np.asarray(st.full_table()) if st is not None
+                     else np.asarray(self._tables[self._table_slot[name]]))
+            mask = spec.owned_mask(self._entity_ids[name], shard_index)
+            out[name] = hashlib.sha256(
+                np.ascontiguousarray(table[mask]).tobytes()).hexdigest()
+        for name, _row_t, _col_t in self._mf_meta:
+            for side in ("/row", "/col"):
+                out[name + side] = full[name + side]
+        return out
 
     def mean_prediction(self, scores: np.ndarray,
                         offsets: Optional[np.ndarray] = None) -> np.ndarray:

@@ -15,6 +15,7 @@ object the serve CLI (and any embedding process) talks to:
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Dict, Optional
 
@@ -26,6 +27,7 @@ from photon_ml_tpu.serving.batcher import (BatcherConfig, MicroBatcher,
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.registry import ModelRegistry
 from photon_ml_tpu.serving.scorer import CompiledScorer
+from photon_ml_tpu.utils import locktrace
 from photon_ml_tpu.utils.events import EventEmitter, ScoringBatchEvent
 
 
@@ -49,6 +51,15 @@ class ServingConfig:
     store_dir: Optional[str] = None
     store_warm_segments: int = 64
     store_seg_rows: int = 16384
+    # entity-sharded serving (fleet/shards.py): a non-None shard_count
+    # makes every scorer this service builds hold ONLY shard
+    # shard_index's slice of the random-effect entity space (FE/MF
+    # coordinates replicate in full), filter replicated deltas to owned
+    # rows, and pre-compile the score_margins() fan-out program
+    shard_index: Optional[int] = None
+    shard_count: Optional[int] = None
+    shard_salt: str = "photon"
+    shard_version: int = 1
 
 
 class ScoringService:
@@ -90,6 +101,23 @@ class ScoringService:
             self.health = HealthMonitor(health, metrics=self.metrics)
         cfg = self.config
 
+        self.shard = None
+        if (cfg.shard_index is None) != (cfg.shard_count is None):
+            raise ValueError("shard_index and shard_count come together "
+                             "(cli.serve --shard K/N)")
+        if cfg.shard_count is not None:
+            from photon_ml_tpu.fleet.shards import ShardAssignment, ShardSpec
+            self.shard = ShardAssignment(
+                spec=ShardSpec(num_shards=cfg.shard_count,
+                               salt=cfg.shard_salt,
+                               version=cfg.shard_version),
+                index=cfg.shard_index)
+            if updates is not None:
+                raise ValueError(
+                    "a sharded service cannot run the online updater: "
+                    "deltas are solved on the (full-model) publisher and "
+                    "replicate shard-filtered through the log")
+
         store_cfg = None
         if cfg.store_budget_rows is not None:
             if cfg.store_dir is None:
@@ -113,24 +141,33 @@ class ScoringService:
             if version_dir is None:  # initial in-memory model
                 scorer = CompiledScorer(model, max_batch=cfg.max_batch,
                                         min_bucket=cfg.min_bucket,
-                                        version=version,
+                                        version=version, shard=self.shard,
                                         **_store_kw(version))
                 scorer.warmup()
                 return scorer
             return CompiledScorer.from_model_dir(
                 version_dir, max_batch=cfg.max_batch,
                 min_bucket=cfg.min_bucket, version=version,
-                **_store_kw(version))
+                shard=self.shard, **_store_kw(version))
 
         self.registry = ModelRegistry(factory, emitter=emitter,
                                       metrics=self.metrics,
                                       max_delta_log=cfg.max_delta_log)
+        # the fan-out margins path bypasses the micro-batcher (its legs
+        # are already device-batch-shaped by the front); this lock gives
+        # it the batcher's one-scoring-thread-at-a-time guarantee, which
+        # is what the tiered store's staging bookkeeping assumes
+        self._margins_lock = locktrace.tracked(
+            threading.Lock(), "ScoringService._margins_lock")
         if store_cfg is not None:
             # both metric surfaces sync the store.* counters to the live
             # scorer's cumulative tier totals at render (the same
             # discipline as the online updater vitals)
             self.metrics.set_store_probe(
                 lambda: self.registry.scorer.store_totals())
+        if self.shard is not None:
+            self.metrics.set_shard_probe(
+                lambda: self.registry.scorer.shard_info())
         if self.health is not None:
             # registered BEFORE the initial load so the first install
             # stamps the version and starts the drift baseline
@@ -200,6 +237,37 @@ class ScoringService:
         """Mean predictions (inverse link), like GameModel.predict."""
         scores = self.score(features, ids, timeout=timeout)
         return self.registry.scorer.mean_prediction(scores, offsets)
+
+    def score_margins(self, features: Dict[str, np.ndarray],
+                      ids: Optional[Dict[str, np.ndarray]] = None) -> Dict:
+        """One leg of a sharded fan-out request: per-coordinate margins
+        from this replica's slice of the entity space, in the scorer's
+        fold order (POST /margins; the front merges legs with
+        fleet.shards.merge_margins).  Unowned/unseen entities contribute
+        exactly 0.0 to their coordinate's margin — the owner's leg holds
+        the real contribution.  Serialized by a dedicated lock rather
+        than the micro-batcher: legs arrive pre-batched by the front."""
+        ids = ids or {}
+        # resolved OUTSIDE _margins_lock: the registry property takes the
+        # registry lock, and a swap landing mid-request is caught by the
+        # front's cross-leg version check either way
+        scorer = self.registry.scorer
+        n = scorer.validate_request(features, ids)
+        t0 = time.monotonic()
+        try:
+            with self._margins_lock:
+                with telemetry.span("serve_margins", rows=n,
+                                    version=scorer.version):
+                    margins = scorer.score_margins(features, ids)
+        except Exception:
+            self.metrics.observe_error()
+            raise
+        self.metrics.observe_request(time.monotonic() - t0, n)
+        return {"margins": margins,
+                "coordinates": scorer.coordinate_meta(),
+                "model_version": scorer.version,
+                "task_type": scorer.model.task_type,
+                "shard": scorer.shard_info()}
 
     def _score_batch(self, features, ids, *, num_requests: int,
                      queue_wait_s: float):
@@ -287,6 +355,12 @@ class ScoringService:
             "updates_enabled": self.updater is not None,
             "health_enabled": self.health is not None,
         }
+        shard = self.registry.scorer.shard_info()
+        if shard is not None:
+            # the front learns shard membership from this key: probed
+            # /healthz payloads are how replicas declare which slice of
+            # the entity space they own (no static fleet topology file)
+            out["shard"] = shard
         store = self.registry.scorer.store_health()
         if store is not None:
             # the tiered store's hit rate is first-class health: a

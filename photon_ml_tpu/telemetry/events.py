@@ -47,6 +47,9 @@ EVENTS: Dict[str, str] = {
     "refit.compact": "fault",
     "refit.validate": "fault",
     "refit.swap": "fault",
+    "shard.route": "fault",
+    "shard.merge": "fault",
+    "shard.catchup": "fault",
     # -- flight-recorder triggers (telemetry.flight.TRIGGERS ->
     #    the `flight_dump` instant event) --------------------------------
     "health.gate_trip": "flight_dump",
@@ -55,4 +58,5 @@ EVENTS: Dict[str, str] = {
     "model.rollback": "flight_dump",
     "serve.drain": "flight_dump",
     "serve.crash": "flight_dump",
+    "shard.lost": "flight_dump",
 }

@@ -170,6 +170,11 @@ def render_prometheus_snapshot(snap: Dict[str, Dict],
     for name, series in snap.get("labeled", {}).items():
         p = _prom_name(name) + "_total"
         typ(p, "counter")
+        if not series:
+            # a registered family with no observed series still exposes
+            # one zero sample, so scrapers (and the JSON/Prometheus
+            # parity contract) see the instrument before first use
+            lines.append(f"{p}{lab} 0")
         for key, value in sorted(series.items()):
             lines.append(f"{p}{_label_str(labels, _parse_label_key(key))} "
                          f"{_prom_value(value)}")

@@ -53,6 +53,7 @@ TRIGGERS: Dict[str, str] = {
     "model.rollback": "a model rollback executed on the live registry",
     "serve.drain": "SIGTERM graceful drain of a serving process",
     "serve.crash": "a serving process is dying on an unhandled error",
+    "shard.lost": "an entity shard's last healthy replica left rotation",
 }
 
 #: default ring capacity (records, not bytes): spans + events + log lines

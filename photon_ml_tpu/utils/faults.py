@@ -134,6 +134,26 @@ Injection sites currently threaded (ctx keys in parentheses):
                     ones leave the incumbent serving — the swap is the
                     LAST step, so a failed publish never strands a
                     half-installed candidate
+  shard.route       one shard group's fan-out leg  (shard)
+                    of a sharded scoring request (fleet/front.py,
+                    before the leg's hedged/failover attempt loop);
+                    transient faults are absorbed by that loop's
+                    failover discipline, fatal ones fail the leg — the
+                    merge then applies the configured degradation
+                    policy (partial-score or error), so a fatal route
+                    fault degrades ONLY requests touching that shard
+  shard.merge       the per-coordinate margin merge (coordinate)
+                    of collected shard legs (fleet/front.py, coordinate
+                    = ","-joined fold order); transient faults retry
+                    the merge (it is a pure host fold over already-
+                    collected legs, so the retry is bit-exact), fatal
+                    ones fail the request with the merge error
+  shard.catchup     one shard-filtered record      (shard)
+                    applied by a sharded replica (fleet/replica.py,
+                    fired inside the apply path so the replica's
+                    standard transient retry/backoff absorbs transient
+                    faults bit-exactly; fatal ones mark the replica
+                    failed exactly like replica.apply)
 """
 from __future__ import annotations
 
@@ -179,6 +199,9 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "refit.compact": ("chunk",),
     "refit.validate": ("candidate",),
     "refit.swap": ("version",),
+    "shard.route": ("shard",),
+    "shard.merge": ("coordinate",),
+    "shard.catchup": ("shard",),
 }
 
 

@@ -700,6 +700,64 @@ def test_multihost_smoke(tmp_path):
     assert d["gates_green"] is True
 
 
+def test_shards_smoke(tmp_path):
+    """bench.py --shards --smoke end-to-end in tier-1 (ISSUE 20
+    satellite): the entity-sharded-serving harness — deterministic/total
+    shard map with spec_id rejection, fan-out merge bit-parity vs the
+    monolithic scorer with zero fresh traces, shard-filtered replay to
+    sha256-exact per-shard audits, the 4x-store-budget capacity claim,
+    and the subprocess SIGKILL/degrade/rejoin leg — cannot rot without
+    failing the normal test run.  The surviving-shard p99 gate is a
+    smoke SIGNAL here (shared-core CI); the committed full bench run
+    gates it hard at 1.2x."""
+    bench = _load_bench()
+    out = tmp_path / "BENCH_shards.json"
+    result = bench.shards_bench(str(out), smoke=True)
+
+    # kill-safe contract: the file on disk IS the returned result
+    assert out.exists()
+    assert json.loads(out.read_text()) == json.loads(json.dumps(result))
+
+    detail = result["detail"]
+    assert detail["smoke"] is True
+    assert detail["all_ok"] is True
+    by_name = {e["name"]: e for e in detail["entries"]}
+    smap = by_name["shards_map"]
+    assert smap["deterministic"] and smap["total"] and smap["roundtrip"]
+    assert smap["spec_id_mismatch_rejected"] is True
+    parity = by_name["shards_parity"]
+    assert parity["rounds_bit_exact"] == parity["rounds"]
+    assert parity["fresh_traces_fanout"] == 0
+    assert parity["all_primaries_exact"] is True
+    replay = by_name["shards_replay"]
+    assert replay["fresh_traces_replay"] == 0
+    assert replay["per_shard_audits_sha256_exact"] is True
+    capacity = by_name["shards_capacity"]
+    assert capacity["rounds_bit_exact"] == capacity["rounds"]
+    assert result["value"] >= 4.0
+    failover = by_name["shards_failover"]
+    assert failover["killed_returncode"] not in (0, 1)  # real SIGKILL
+    assert failover["baseline"]["errors"] == 0
+    assert failover["baseline"]["inexact"] == 0
+    assert failover["one_shard_down"]["errors"] == 0
+    assert failover["one_shard_down"]["inexact"] == 0
+    assert failover["errors_confined_to_lost_shard"] is True
+    assert failover["rejoin_audit_sha256_exact"] is True
+    assert failover["rejoin_heals_degraded_request"] is True
+
+    # --max-wall is honored: an exhausted budget skips the heavy legs
+    # with explicit "truncated" markers instead of blowing the suite
+    # budget (the JSON still lands atomically, exit stays clean)
+    out2 = tmp_path / "BENCH_shards_wall.json"
+    result2 = bench.shards_bench(str(out2), smoke=True, max_wall=0.0)
+    assert out2.exists()
+    d2 = result2["detail"]
+    assert set(d2["truncated"]) == {
+        "shards_map", "shards_parity", "shards_replay",
+        "shards_capacity", "shards_failover"}
+    assert d2["all_ok"] is False
+
+
 def test_max_wall_truncates_and_exits_cleanly(tmp_path, monkeypatch):
     """--max-wall budget (ISSUE 4 satellite): an exhausted wall budget
     SKIPS the remaining configs, writes the partial JSON with a
